@@ -1,0 +1,30 @@
+"""spark_rapids_tpu — TPU-native columnar acceleration layer for Apache Spark.
+
+A from-scratch re-design of the capabilities of NVIDIA's spark-rapids-jni
+(reference at /root/reference; structural analysis in SURVEY.md) on an
+idiomatic JAX/XLA/Pallas/PJRT stack:
+
+- `columnar`: HBM-resident Arrow-layout Column/Table substrate (pytrees).
+- `ops`: Spark-exact kernels — casts, hashes, bloom filter, decimal128
+  arithmetic, datetime rebase, timezones, zorder, parse_uri, JSON→map,
+  histogram/percentile, row↔columnar conversion, groupby/join/sort.
+- `runtime`: host-side C++ task/memory arbitration state machine (retry,
+  split-and-retry, BUFN, deadlock watchdog, OOM injection, metrics) — the
+  TPU equivalent of SparkResourceAdaptor (SURVEY.md §2.2).
+- `parallel`: device-mesh sharding + ICI/DCN all-to-all partition exchange
+  (the slot the GPU stack fills with UCX shuffle).
+- `io`: native parquet footer parse/prune/filter.
+
+int64 is pervasive in Spark data (timestamps, longs, xxhash64), so this
+package enables jax x64 mode on import; XLA:TPU emulates s64/u64 with 32-bit
+pairs, which is correct (full wrap-around) and off the hot matmul path.
+"""
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from . import dtypes                                    # noqa: E402
+from .columnar import Column, Table                     # noqa: E402
+
+__version__ = "0.1.0"
+__all__ = ["dtypes", "Column", "Table", "__version__"]

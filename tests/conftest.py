@@ -1,0 +1,15 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh (the reference's tests likewise never
+need a cluster — SURVEY.md §4 "they don't need to"; multi-tenancy/multi-device
+is simulated). Real-TPU runs use bench.py / __graft_entry__.py.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

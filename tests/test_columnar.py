@@ -1,0 +1,85 @@
+"""Columnar substrate tests: construction, nulls, string padding round-trips."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import dtypes
+from spark_rapids_tpu.columnar import Column, Table, strings_from_padded
+
+
+def test_fixed_width_roundtrip():
+    col = Column.from_pylist([1, None, 3, -4], dtypes.INT32)
+    assert col.length == 4
+    assert col.null_count() == 1
+    assert col.to_pylist() == [1, None, 3, -4]
+
+
+def test_all_valid_has_no_mask():
+    col = Column.from_pylist([1, 2, 3], dtypes.INT64)
+    assert col.validity is None
+    assert col.null_count() == 0
+
+
+def test_string_roundtrip():
+    vals = ["hello", None, "", "wörld", "a" * 100]
+    col = Column.from_pylist(vals, dtypes.STRING)
+    assert col.to_pylist() == vals
+    assert col.null_count() == 1
+
+
+def test_padded_chars():
+    col = Column.from_pylist(["ab", "c", ""], dtypes.STRING)
+    padded, lens = col.padded_chars(pad_to=4)
+    assert padded.shape == (3, 4)
+    np.testing.assert_array_equal(np.asarray(lens), [2, 1, 0])
+    np.testing.assert_array_equal(np.asarray(padded[0]), [ord("a"), ord("b"), 0, 0])
+    np.testing.assert_array_equal(np.asarray(padded[1]), [ord("c"), 0, 0, 0])
+
+
+def test_strings_from_padded_roundtrip():
+    vals = ["spark", "", "tpu", None, "xyz"]
+    col = Column.from_pylist(vals, dtypes.STRING)
+    padded, lens = col.padded_chars(pad_to=8)
+    rebuilt = strings_from_padded(padded, lens, col.validity)
+    assert rebuilt.to_pylist() == vals
+
+
+def test_decimal128_roundtrip():
+    vals = [0, 1, -1, (1 << 100), -(1 << 100), None]
+    dt = dtypes.decimal(38, 0)
+    col = Column.from_pylist(vals, dt)
+    assert col.dtype.kind == dtypes.Kind.DECIMAL128
+    assert col.to_pylist() == vals
+
+
+def test_decimal_storage_selection():
+    assert dtypes.decimal(9, 2).kind == dtypes.Kind.DECIMAL32
+    assert dtypes.decimal(10, 2).kind == dtypes.Kind.DECIMAL64
+    assert dtypes.decimal(18, 2).kind == dtypes.Kind.DECIMAL64
+    assert dtypes.decimal(19, 2).kind == dtypes.Kind.DECIMAL128
+    assert dtypes.decimal(38, 2).kind == dtypes.Kind.DECIMAL128
+    with pytest.raises(ValueError):
+        dtypes.decimal(39, 0)
+
+
+def test_table_basics():
+    t = Table.from_pydict({
+        "a": Column.from_pylist([1, 2, 3], dtypes.INT32),
+        "b": Column.from_pylist(["x", "y", None], dtypes.STRING),
+    })
+    assert t.num_rows == 3
+    assert t.num_columns == 2
+    assert t["b"].to_pylist() == ["x", "y", None]
+    t2 = t.with_column("c", Column.from_pylist([0.5, 1.5, 2.5], dtypes.FLOAT64))
+    assert t2.num_columns == 3
+    assert t.num_columns == 2  # immutability
+
+
+def test_nested_list_struct():
+    child = Column.from_pylist([1, 2, 3, 4, 5], dtypes.INT32)
+    lst = Column.make_list(jnp.asarray([0, 2, 2, 5], jnp.int32), child)
+    assert lst.to_pylist() == [[1, 2], [], [3, 4, 5]]
+    st = Column.make_struct(
+        k=Column.from_pylist(["a", "b"], dtypes.STRING),
+        v=Column.from_pylist([1, 2], dtypes.INT64))
+    assert st.to_pylist() == [{"k": "a", "v": 1}, {"k": "b", "v": 2}]
