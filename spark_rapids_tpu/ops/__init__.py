@@ -1,0 +1,3 @@
+from .hash import murmur_hash3_32, xxhash64, DEFAULT_XXHASH64_SEED
+
+__all__ = ["murmur_hash3_32", "xxhash64", "DEFAULT_XXHASH64_SEED"]

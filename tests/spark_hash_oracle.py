@@ -1,0 +1,152 @@
+"""Independent pure-Python oracle for Spark's murmur3_32 / xxhash64 semantics.
+
+Implements Spark's hash algorithms (org.apache.spark.sql.catalyst.expressions
+Murmur3HashFunction / XxHash64Function) directly in Python integers, used to
+cross-check the JAX kernels on randomized inputs. Golden vectors from real
+Spark runs (mirrored in the reference's tests/hash.cpp) anchor the oracle.
+"""
+import math
+import struct
+
+M32 = 0xFFFFFFFF
+M64 = 0xFFFFFFFFFFFFFFFF
+
+
+def _rotl32(x, r):
+    return ((x << r) | (x >> (32 - r))) & M32
+
+
+def _rotl64(x, r):
+    return ((x << r) | (x >> (64 - r))) & M64
+
+
+def murmur32_bytes(data: bytes, seed: int) -> int:
+    """Spark murmur3_32: 4-byte LE blocks, then per-byte signed-char tail."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & M32
+    nblocks = len(data) // 4
+    for i in range(nblocks):
+        k1 = struct.unpack_from("<I", data, i * 4)[0]
+        k1 = (k1 * c1) & M32
+        k1 = _rotl32(k1, 15)
+        k1 = (k1 * c2) & M32
+        h ^= k1
+        h = _rotl32(h, 13)
+        h = (h * 5 + 0xE6546B64) & M32
+    for i in range(nblocks * 4, len(data)):
+        b = data[i]
+        if b >= 128:
+            b -= 256  # signed char
+        k1 = (b & M32) * c1 & M32
+        k1 = _rotl32(k1, 15)
+        k1 = (k1 * c2) & M32
+        h ^= k1
+        h = _rotl32(h, 13)
+        h = (h * 5 + 0xE6546B64) & M32
+    h ^= len(data)
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & M32
+    h ^= h >> 16
+    return h if h < (1 << 31) else h - (1 << 32)
+
+
+P1 = 0x9E3779B185EBCA87
+P2 = 0xC2B2AE3D27D4EB4F
+P3 = 0x165667B19E3779F9
+P4 = 0x85EBCA77C2B2AE63
+P5 = 0x27D4EB2F165667C5
+
+
+def xxhash64_bytes(data: bytes, seed: int) -> int:
+    seed &= M64
+    n = len(data)
+    off = 0
+    if n >= 32:
+        v1 = (seed + P1 + P2) & M64
+        v2 = (seed + P2) & M64
+        v3 = seed
+        v4 = (seed - P1) & M64
+        while off + 32 <= n:
+            for idx in range(4):
+                w = struct.unpack_from("<Q", data, off)[0]
+                v = (v1, v2, v3, v4)[idx]
+                v = (v + w * P2) & M64
+                v = _rotl64(v, 31)
+                v = (v * P1) & M64
+                if idx == 0:
+                    v1 = v
+                elif idx == 1:
+                    v2 = v
+                elif idx == 2:
+                    v3 = v
+                else:
+                    v4 = v
+                off += 8
+        h = (_rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12) + _rotl64(v4, 18)) & M64
+        for v in (v1, v2, v3, v4):
+            v = (v * P2) & M64
+            v = _rotl64(v, 31)
+            v = (v * P1) & M64
+            h ^= v
+            h = (h * P1 + P4) & M64
+    else:
+        h = (seed + P5) & M64
+    h = (h + n) & M64
+    while off + 8 <= n:
+        w = struct.unpack_from("<Q", data, off)[0]
+        k1 = (w * P2) & M64
+        k1 = _rotl64(k1, 31)
+        k1 = (k1 * P1) & M64
+        h ^= k1
+        h = (_rotl64(h, 27) * P1 + P4) & M64
+        off += 8
+    if off + 4 <= n:
+        w = struct.unpack_from("<I", data, off)[0]
+        h ^= (w * P1) & M64
+        h = (_rotl64(h, 23) * P2 + P3) & M64
+        off += 4
+    while off < n:
+        h ^= (data[off] * P5) & M64
+        h = (_rotl64(h, 11) * P1) & M64
+        off += 1
+    h ^= h >> 33
+    h = (h * P2) & M64
+    h ^= h >> 29
+    h = (h * P3) & M64
+    h ^= h >> 32
+    return h if h < (1 << 63) else h - (1 << 64)
+
+
+# ---------------------------------------------------------------------------
+# element encodings (Spark's byte forms)
+# ---------------------------------------------------------------------------
+def encode_int4(v: int) -> bytes:
+    return struct.pack("<i", v)
+
+
+def encode_int8(v: int) -> bytes:
+    return struct.pack("<q", v)
+
+
+def encode_float(v: float, normalize_zero: bool) -> bytes:
+    if math.isnan(v):
+        return struct.pack("<I", 0x7FC00000)
+    if normalize_zero and v == 0.0:
+        v = 0.0
+    return struct.pack("<f", v)
+
+
+def encode_double(v: float, normalize_zero: bool) -> bytes:
+    if math.isnan(v):
+        return struct.pack("<Q", 0x7FF8000000000000)
+    if normalize_zero and v == 0.0:
+        v = 0.0
+    return struct.pack("<d", v)
+
+
+def encode_decimal128(unscaled: int) -> bytes:
+    """Minimal big-endian two's-complement (BigDecimal.unscaledValue().toByteArray())."""
+    nbytes = (unscaled if unscaled >= 0 else ~unscaled).bit_length() // 8 + 1
+    return unscaled.to_bytes(nbytes, "big", signed=True)

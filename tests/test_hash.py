@@ -1,0 +1,447 @@
+"""Hash kernel tests: Spark golden vectors (from real Spark runs, mirrored in
+the reference's tests/hash.cpp) + randomized comparison against the pure-
+Python oracle."""
+import random
+import struct
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import dtypes
+from spark_rapids_tpu.columnar import Column, Table
+from spark_rapids_tpu.ops.hash import murmur_hash3_32, xxhash64
+
+import spark_hash_oracle as oracle
+
+F32 = np.finfo(np.float32)
+F64 = np.finfo(np.float64)
+I32, I64 = np.iinfo(np.int32), np.iinfo(np.int64)
+
+# The fifth test string contains unpaired UTF-16 surrogates U+D720 U+D721,
+# which Spark stores as their raw 3-byte UTF-8-style encodings.
+PUNCT = ("!\"#$%&'()*+,-./:;<=>?@[\\]^_`{|}~").encode() + \
+    "휠휡".encode("utf-8", "surrogatepass")
+STRINGS5 = [b"", b"The quick brown fox", b"jumps over the lazy dog.",
+            b"All work and no play makes Jack a dull boy", PUNCT]
+
+DEC128_VALS = [
+    0, 100, -1,
+    int.from_bytes(struct.pack(">QQ", 0xFFFFFFFFFCC4D1C3, 0x602F7FC318000001), "big", signed=True),
+    int.from_bytes(struct.pack(">QQ", 0x0785EE10D5DA46D9, 0x00F4369FFFFFFFFF), "big", signed=True),
+]
+
+
+def col(vals, dt):
+    return Column.from_pylist(vals, dt)
+
+
+def assert_hashes(result: Column, expected):
+    np.testing.assert_array_equal(np.asarray(result.data), np.array(expected))
+
+
+# ---------------------------------------------------------------------------
+# murmur3_32 golden vectors (Spark output, seed 42 unless noted)
+# ---------------------------------------------------------------------------
+class TestMurmurGolden:
+    def test_strings_seed42(self):
+        c = col(STRINGS5, dtypes.STRING)
+        assert_hashes(murmur_hash3_32([c], 42),
+                      [142593372, 1217302703, -715697185, -2061143941, -111635966])
+
+    def test_strings_seed314(self):
+        c = col(STRINGS5, dtypes.STRING)
+        assert_hashes(murmur_hash3_32([c], 314),
+                      [1467149710, 723257560, -1620282500, -2001858707, 1588473657])
+
+    def test_doubles(self):
+        c = col([0., -0., -np.nan, F64.min, F64.max], dtypes.FLOAT64)
+        assert_hashes(murmur_hash3_32([c], 42),
+                      [-1670924195, -853646085, -1281358385, 1897734433, -508695674])
+
+    def test_floats(self):
+        c = col([0., -0., -np.nan, F32.min, F32.max], dtypes.FLOAT32)
+        assert_hashes(murmur_hash3_32([c], 42),
+                      [933211791, 723455942, -349261430, -1225560532, -338752985])
+
+    def test_longs(self):
+        c = col([0, 100, -100, I64.min, I64.max], dtypes.INT64)
+        assert_hashes(murmur_hash3_32([c], 42),
+                      [-1670924195, 1114849490, 904948192, -853646085, -1604625029])
+
+    def test_ints(self):
+        c = col([0, 100, -100, I32.min, I32.max], dtypes.INT32)
+        assert_hashes(murmur_hash3_32([c], 42),
+                      [933211791, 751823303, -1080202046, 723455942, 133916647])
+
+    def test_shorts(self):
+        c = col([0, 100, -100, -32768, 32767], dtypes.INT16)
+        assert_hashes(murmur_hash3_32([c], 42),
+                      [933211791, 751823303, -1080202046, -1871935946, 1249274084])
+
+    def test_bytes(self):
+        c = col([0, 100, -100, -128, 127], dtypes.INT8)
+        assert_hashes(murmur_hash3_32([c], 42),
+                      [933211791, 751823303, -1080202046, 1110053733, 1135925485])
+
+    def test_bools(self):
+        c = col([False, True, True, True, False], dtypes.BOOL)
+        assert_hashes(murmur_hash3_32([c], 42),
+                      [933211791, -559580957, -559580957, -559580957, 933211791])
+
+    def test_timestamps(self):
+        c = col([0, 100, -100, -(I64.min // -1000000), I64.max // 1000000],
+                dtypes.TIMESTAMP_US)
+        assert_hashes(murmur_hash3_32([c], 42),
+                      [-1670924195, 1114849490, 904948192, -1832979433, 1752430209])
+
+    def test_dates(self):
+        c = col([0, 100, -100, -((2**31) // 100), (2**31 - 1) // 100], dtypes.DATE32)
+        assert_hashes(murmur_hash3_32([c], 42),
+                      [933211791, 751823303, -1080202046, -1906567553, -1503850410])
+
+    def test_decimal32(self):
+        c = col([0, 100, -100, -999999999, 999999999], dtypes.decimal(9, 3))
+        assert_hashes(murmur_hash3_32([c], 42),
+                      [-1670924195, 1114849490, 904948192, -1454351396, -193774131])
+
+    def test_decimal64(self):
+        c = col([0, 100, -100, -999999999999999999, 999999999999999999],
+                dtypes.decimal(18, 7))
+        assert_hashes(murmur_hash3_32([c], 42),
+                      [-1670924195, 1114849490, 904948192, 1962370902, -1795328666])
+
+    def test_decimal128(self):
+        c = col(DEC128_VALS, dtypes.decimal(38, 11))
+        assert_hashes(murmur_hash3_32([c], 42),
+                      [-783713497, -295670906, 1398487324, -52622807, -1359749815])
+
+    def test_structs(self):
+        a = col([0, 100, -100, 0x12345678, -0x76543210], dtypes.INT32)
+        b = col(["a", "bc", "def", "ghij", "klmno"], dtypes.STRING)
+        x = col([0., 100., -100., np.inf, -np.inf], dtypes.FLOAT32)
+        y = col([0, 100, -100, 0x0123456789ABCDEF, -0x0123456789ABCDEF], dtypes.INT64)
+        inner = Column.make_struct(x=x, y=y)
+        structs = Column.make_struct(a=a, b=b, c=inner)
+        assert_hashes(murmur_hash3_32([structs], 42),
+                      [-105406170, 90479889, -678041645, 1667387937, 301478567])
+
+    def test_combined_chained(self):
+        cols = [
+            Column.make_struct(
+                a=col([0, 100, -100, 0x12345678, -0x76543210], dtypes.INT32),
+                b=col(["a", "bc", "def", "ghij", "klmno"], dtypes.STRING),
+                c=Column.make_struct(
+                    x=col([0., 100., -100., np.inf, -np.inf], dtypes.FLOAT32),
+                    y=col([0, 100, -100, 0x0123456789ABCDEF, -0x0123456789ABCDEF],
+                          dtypes.INT64))),
+            col(STRINGS5, dtypes.STRING),
+            col([0., -0., -np.nan, F64.min, F64.max], dtypes.FLOAT64),
+            col([0, 100, -100, -(I64.min // -1000000), I64.max // 1000000],
+                dtypes.TIMESTAMP_US),
+            col([0, 100, -100, -999999999999999999, 999999999999999999],
+                dtypes.decimal(18, 7)),
+            col([0, 100, -100, I64.min, I64.max], dtypes.INT64),
+            col([0., -0., -np.nan, F32.min, F32.max], dtypes.FLOAT32),
+            col([0, 100, -100, -((2**31) // 100), (2**31 - 1) // 100], dtypes.DATE32),
+            col([0, 100, -100, -999999999, 999999999], dtypes.decimal(9, 3)),
+            col([0, 100, -100, I32.min, I32.max], dtypes.INT32),
+            col([0, 100, -100, -32768, 32767], dtypes.INT16),
+            col([0, 100, -100, -128, 127], dtypes.INT8),
+            col([False, True, True, True, False], dtypes.BOOL),
+            col(DEC128_VALS, dtypes.decimal(38, 11)),
+        ]
+        assert_hashes(murmur_hash3_32(cols, 42),
+                      [401603227, 588162166, 552160517, 1132537411, -326043017])
+
+    def test_list_of_struct_rejected(self):
+        st = Column.make_struct(v=col([1, 2, 3], dtypes.INT32))
+        lst = Column.make_list(np.array([0, 1, 3], np.int32), st)
+        with pytest.raises(TypeError):
+            murmur_hash3_32([lst], 42)
+
+    def test_empty_input_rejected(self):
+        with pytest.raises(ValueError):
+            murmur_hash3_32([], 42)
+
+
+# ---------------------------------------------------------------------------
+# xxhash64 golden vectors (Spark output, seed 42); rows 6 is null -> seed
+# ---------------------------------------------------------------------------
+V8 = [True] * 5 + [False] + [True] * 2
+
+
+def colv(vals, dt):
+    vals = list(vals)
+    return Column.from_pylist(
+        [v if V8[i] else None for i, v in enumerate(vals)], dt)
+
+
+class TestXXHash64Golden:
+    def test_strings(self):
+        c = colv(STRINGS5 + [b"", b"abcdefgh", b"abcdefghi"], dtypes.STRING)
+        assert_hashes(xxhash64([c], 42),
+                      [-7444071767201028348, -3617261401988713833, 8198945020833482635,
+                       -5346617152005100141, 6614298085531227868, 42,
+                       2470326616177429180, -7093207067522615973])
+
+    def test_doubles(self):
+        c = colv([0., -0., -np.nan, F64.min, F64.max, 0., 100., 200.], dtypes.FLOAT64)
+        assert_hashes(xxhash64([c], 42),
+                      [-5252525462095825812, -5252525462095825812, -3127944061524951246,
+                       9065082843545458248, -4222314252576420879, 42,
+                       -7996023612001835843, -8838535416664833914])
+
+    def test_floats(self):
+        c = colv([0., -0., -np.nan, F32.min, F32.max, 0., np.inf, -np.inf],
+                 dtypes.FLOAT32)
+        assert_hashes(xxhash64([c], 42),
+                      [3614696996920510707, 3614696996920510707, 2692338816207849720,
+                       -8545425418825163117, -1065250890878313112, 42,
+                       -5940311692336719973, -7580553461823983095])
+
+    def test_longs(self):
+        c = colv([0, 100, -100, I64.min, I64.max, 0, 0x123456789ABCDEF,
+                  -0x123456789ABCDEF], dtypes.INT64)
+        assert_hashes(xxhash64([c], 42),
+                      [-5252525462095825812, 8713583529807266080, 5675770457807661948,
+                       -8619748838626508300, -3246596055638297850, 42,
+                       1941233597257011502, -1318946533059658749])
+
+    def test_ints(self):
+        c = colv([0, 100, -100, I32.min, I32.max, 0, -200, -300], dtypes.INT32)
+        assert_hashes(xxhash64([c], 42),
+                      [3614696996920510707, -7987742665087449293, 8990748234399402673,
+                       2073849959933241805, 1508894993788531228, 42,
+                       -953008374380745918, 2895908635257747121])
+
+    def test_shorts(self):
+        c = colv([0, 100, -100, -32768, 32767, 0, -200, -300], dtypes.INT16)
+        assert_hashes(xxhash64([c], 42),
+                      [3614696996920510707, -7987742665087449293, 8990748234399402673,
+                       -904511417458573795, 8952525448871805501, 42,
+                       -953008374380745918, 2895908635257747121])
+
+    def test_bytes(self):
+        c = colv([0, 100, -100, -128, 127, 0, -90, -80], dtypes.INT8)
+        assert_hashes(xxhash64([c], 42),
+                      [3614696996920510707, -7987742665087449293, 8990748234399402673,
+                       4160238337661960656, 8632298611707923906, 42,
+                       -4008061843281999337, 6690883199412647955])
+
+    def test_bools(self):
+        c = colv([False, True, True, True, False, False, False, False], dtypes.BOOL)
+        assert_hashes(xxhash64([c], 42),
+                      [3614696996920510707, -6698625589789238999, -6698625589789238999,
+                       -6698625589789238999, 3614696996920510707, 42,
+                       3614696996920510707, 3614696996920510707])
+
+    def test_dates(self):
+        c = colv([0, 100, -100, -((2**31) // 100), (2**31 - 1) // 100, 0, -200, -300],
+                 dtypes.DATE32)
+        assert_hashes(xxhash64([c], 42),
+                      [3614696996920510707, -7987742665087449293, 8990748234399402673,
+                       -8442426365007754391, -1447590449373190349, 42,
+                       -953008374380745918, 2895908635257747121])
+
+    def test_decimal32(self):
+        c = colv([0, 100, -100, -999999999, 999999999, 0, -200, -300],
+                 dtypes.decimal(9, 3))
+        assert_hashes(xxhash64([c], 42),
+                      [-5252525462095825812, 8713583529807266080, 5675770457807661948,
+                       8670643431269007867, 6810183316718625826, 42,
+                       7277994511003214036, 6264187449999859617])
+
+    def test_decimal64(self):
+        c = colv([0, 100, -100, -999999999999999999, 999999999999999999, 0, 123, 432],
+                 dtypes.decimal(18, 7))
+        assert_hashes(xxhash64([c], 42),
+                      [-5252525462095825812, 8713583529807266080, 5675770457807661948,
+                       4265531446127695490, 2162198894918931945, 42,
+                       -3178482946328430151, 4788666723486520022])
+
+    def test_decimal128(self):
+        c = colv([0, 100, -1, DEC128_VALS[3], DEC128_VALS[4], 0, DEC128_VALS[3],
+                  DEC128_VALS[4]], dtypes.decimal(38, 11))
+        assert_hashes(xxhash64([c], 42),
+                      [-8959994473701255385, 4409375254388155230, -4006032525457443936,
+                       -5423362182451591024, 7041733194569950081, 42,
+                       -5423362182451591024, 7041733194569950081])
+
+    def test_timestamps(self):
+        c = colv([0, 100, -100, -(I64.min // -1000000), I64.max // 1000000, 0, 200, 300],
+                 dtypes.TIMESTAMP_US)
+        assert_hashes(xxhash64([c], 42),
+                      [-5252525462095825812, 8713583529807266080, 5675770457807661948,
+                       7123048472642709644, -5141505295506489983, 42,
+                       -1244884446866925109, 1772389229253425430])
+
+    def test_combined(self):
+        cols = [
+            colv(STRINGS5 + [b"", b"abcdefgh", b"abcdefghi"], dtypes.STRING),
+            colv([0., -0., -np.nan, F64.min, F64.max, 0., 100., 200.], dtypes.FLOAT64),
+            colv([0, 100, -100, -(I64.min // -1000000), I64.max // 1000000, 0, 200, 300],
+                 dtypes.TIMESTAMP_US),
+            colv([0, 100, -100, -999999999999999999, 999999999999999999, 0, 123, 432],
+                 dtypes.decimal(18, 7)),
+            colv([0, 100, -100, I64.min, I64.max, 0, 0x123456789ABCDEF,
+                  -0x123456789ABCDEF], dtypes.INT64),
+            colv([0., -0., -np.nan, F32.min, F32.max, 0., np.inf, -np.inf],
+                 dtypes.FLOAT32),
+            colv([0, 100, -100, -((2**31) // 100), (2**31 - 1) // 100, 0, -200, -300],
+                 dtypes.DATE32),
+            colv([0, 100, -100, -999999999, 999999999, 0, -200, -300],
+                 dtypes.decimal(9, 3)),
+            colv([0, 100, -100, I32.min, I32.max, 0, -200, -300], dtypes.INT32),
+            colv([0, 100, -100, -32768, 32767, 0, -200, -300], dtypes.INT16),
+            colv([0, 100, -100, -128, 127, 0, -90, -80], dtypes.INT8),
+            colv([False, True, True, True, False, False, False, False], dtypes.BOOL),
+            colv([0, 100, -1, DEC128_VALS[3], DEC128_VALS[4], 0, DEC128_VALS[3],
+                  DEC128_VALS[4]], dtypes.decimal(38, 11)),
+        ]
+        assert_hashes(xxhash64(cols, 42),
+                      [541735645035655239, 9011982951766246298, 3834379147931449211,
+                       -5406325166887725795, 7797509897614041972, 42,
+                       -9032872913521304524, -604070008711895908])
+
+    def test_nested_rejected(self):
+        st = Column.make_struct(v=Column.from_pylist([1], dtypes.INT32))
+        with pytest.raises(TypeError):
+            xxhash64([st], 42)
+
+
+# ---------------------------------------------------------------------------
+# randomized oracle comparison
+# ---------------------------------------------------------------------------
+class TestRandomizedOracle:
+    def test_strings_random(self):
+        rng = random.Random(1234)
+        vals = []
+        for _ in range(200):
+            n = rng.randrange(0, 80)
+            vals.append(bytes(rng.randrange(256) for _ in range(n)))
+        c = Column.from_pylist(vals, dtypes.STRING)
+        for seed in (0, 42, -7):
+            got = np.asarray(murmur_hash3_32([c], seed).data)
+            exp = [oracle.murmur32_bytes(v, seed) for v in vals]
+            np.testing.assert_array_equal(got, exp)
+            got64 = np.asarray(xxhash64([c], seed).data)
+            exp64 = [oracle.xxhash64_bytes(v, seed & oracle.M64) for v in vals]
+            np.testing.assert_array_equal(got64, exp64)
+
+    def test_long_strings_cross_stripe(self):
+        """Lengths straddling the 32-byte xxhash64 stripe boundary."""
+        vals = [bytes(range(i % 256)) * 3 for i in range(0, 50)] + \
+               [b"x" * n for n in (31, 32, 33, 63, 64, 65, 127, 128, 255)]
+        c = Column.from_pylist(vals, dtypes.STRING)
+        got = np.asarray(xxhash64([c], 42).data)
+        exp = [oracle.xxhash64_bytes(v, 42) for v in vals]
+        np.testing.assert_array_equal(got, exp)
+        gotm = np.asarray(murmur_hash3_32([c], 42).data)
+        expm = [oracle.murmur32_bytes(v, 42) for v in vals]
+        np.testing.assert_array_equal(gotm, expm)
+
+    def test_ints_random(self):
+        rng = np.random.default_rng(99)
+        vals = rng.integers(I64.min, I64.max, size=500, dtype=np.int64)
+        c = Column.from_numpy(vals)
+        got = np.asarray(murmur_hash3_32([c], 0).data)
+        exp = [oracle.murmur32_bytes(oracle.encode_int8(int(v)), 0) for v in vals]
+        np.testing.assert_array_equal(got, exp)
+
+    def test_decimal128_random(self):
+        rng = random.Random(7)
+        vals = [rng.randrange(-(1 << 127), 1 << 127) for _ in range(200)] + \
+               [0, 1, -1, 127, 128, -128, -129, 255, 256, -(1 << 127), (1 << 127) - 1]
+        c = Column.from_pylist(vals, dtypes.decimal(38, 0))
+        got = np.asarray(murmur_hash3_32([c], 42).data)
+        exp = [oracle.murmur32_bytes(oracle.encode_decimal128(v), 42) for v in vals]
+        np.testing.assert_array_equal(got, exp)
+        got64 = np.asarray(xxhash64([c], 42).data)
+        exp64 = [oracle.xxhash64_bytes(oracle.encode_decimal128(v), 42) for v in vals]
+        np.testing.assert_array_equal(got64, exp64)
+
+    def test_nulls_pass_seed(self):
+        c1 = Column.from_pylist([1, None, 3], dtypes.INT32)
+        c2 = Column.from_pylist([None, None, 7], dtypes.INT64)
+        got = np.asarray(murmur_hash3_32([c1, c2], 42).data)
+        # row 0: col1 hashes, col2 null -> unchanged
+        h0 = oracle.murmur32_bytes(oracle.encode_int4(1), 42)
+        assert got[0] == h0
+        # row 1: both null -> seed itself
+        assert got[1] == 42
+        # row 2: chain
+        h2 = oracle.murmur32_bytes(oracle.encode_int4(3), 42)
+        h2 = oracle.murmur32_bytes(oracle.encode_int8(7), h2 & oracle.M32)
+        assert got[2] == h2
+
+
+class TestListHashing:
+    def test_list_of_ints_matches_flat_chain(self):
+        """Spark semantics: hash of [1,2] == chained hash of elements."""
+        child = Column.from_pylist([1, 2, 3, 4, 5, 6], dtypes.INT32)
+        lst = Column.make_list(np.array([0, 2, 2, 6], np.int32), child)
+        got = np.asarray(murmur_hash3_32([lst], 42).data)
+        h0 = oracle.murmur32_bytes(oracle.encode_int4(1), 42)
+        h0 = oracle.murmur32_bytes(oracle.encode_int4(2), h0 & oracle.M32)
+        assert got[0] == h0
+        assert got[1] == 42  # empty list -> seed
+        h2 = 42
+        for v in (3, 4, 5, 6):
+            h2 = oracle.murmur32_bytes(oracle.encode_int4(v), h2 & oracle.M32)
+        assert got[2] == h2
+
+    def test_list_null_elements_skipped(self):
+        child = Column.from_pylist([1, None, 2], dtypes.INT32)
+        lst = Column.make_list(np.array([0, 3], np.int32), child)
+        got = np.asarray(murmur_hash3_32([lst], 42).data)
+        h = oracle.murmur32_bytes(oracle.encode_int4(1), 42)
+        h = oracle.murmur32_bytes(oracle.encode_int4(2), h & oracle.M32)
+        assert got[0] == h
+
+    def test_list_of_strings(self):
+        child = Column.from_pylist(["ab", "cde", "f"], dtypes.STRING)
+        lst = Column.make_list(np.array([0, 2, 3], np.int32), child)
+        got = np.asarray(murmur_hash3_32([lst], 7).data)
+        h0 = oracle.murmur32_bytes(b"ab", 7)
+        h0 = oracle.murmur32_bytes(b"cde", h0 & oracle.M32)
+        assert got[0] == h0
+        assert got[1] == oracle.murmur32_bytes(b"f", 7)
+
+
+class TestReviewRegressions:
+    def test_list_of_decimal128(self):
+        child = Column.from_pylist([1, -1, 10**30], dtypes.decimal(38, 0))
+        lst = Column.make_list(np.array([0, 2, 3], np.int32), child)
+        got = np.asarray(murmur_hash3_32([lst], 42).data)
+        h0 = oracle.murmur32_bytes(oracle.encode_decimal128(1), 42)
+        h0 = oracle.murmur32_bytes(oracle.encode_decimal128(-1), h0 & oracle.M32)
+        assert got[0] == h0
+        assert got[1] == oracle.murmur32_bytes(oracle.encode_decimal128(10**30), 42)
+
+    def test_hash_traces_under_jit(self):
+        import jax
+        c = Column.from_pylist(["spark", "tpu", None, "columnar"], dtypes.STRING)
+        i = Column.from_pylist([1, 2, 3, 4], dtypes.INT64)
+
+        @jax.jit
+        def f(cc, ii):
+            return (murmur_hash3_32([cc, ii], 42, pad_to=16).data,
+                    xxhash64([cc, ii], 42, pad_to=16).data)
+
+        m, x = f(c, i)
+        me = np.asarray(murmur_hash3_32([c, i], 42).data)
+        xe = np.asarray(xxhash64([c, i], 42).data)
+        np.testing.assert_array_equal(np.asarray(m), me)
+        np.testing.assert_array_equal(np.asarray(x), xe)
+
+    def test_list_traces_under_jit(self):
+        import jax
+        child = Column.from_pylist([1, 2, 3, 4, 5], dtypes.INT32)
+        lst = Column.make_list(np.array([0, 2, 5], np.int32), child)
+
+        @jax.jit
+        def f(l):
+            return murmur_hash3_32([l], 42, max_span=8).data
+
+        np.testing.assert_array_equal(
+            np.asarray(f(lst)), np.asarray(murmur_hash3_32([lst], 42).data))
