@@ -113,6 +113,13 @@ class Column:
         lens = self.string_lengths()
         if pad_to is None:
             pad_to = _round_bucket(max(1, self.max_string_length()))
+        elif not isinstance(lens, jax.core.Tracer):
+            # a too-small pad silently truncates rows, corrupting every
+            # downstream kernel - reject when we can see concrete lengths
+            m = self.max_string_length()
+            if m > pad_to:
+                raise ValueError(
+                    f"pad_to={pad_to} is smaller than the longest string ({m})")
         starts = self.offsets[:-1]
         idx = starts[:, None] + jnp.arange(pad_to, dtype=jnp.int32)[None, :]
         in_range = jnp.arange(pad_to, dtype=jnp.int32)[None, :] < lens[:, None]

@@ -1,3 +1,10 @@
 from .hash import murmur_hash3_32, xxhash64, DEFAULT_XXHASH64_SEED
+from .cast_string import (CastError, string_to_integer, string_to_float,
+                          string_to_integer_with_base,
+                          integer_to_string_with_base)
 
-__all__ = ["murmur_hash3_32", "xxhash64", "DEFAULT_XXHASH64_SEED"]
+__all__ = [
+    "murmur_hash3_32", "xxhash64", "DEFAULT_XXHASH64_SEED",
+    "CastError", "string_to_integer", "string_to_float",
+    "string_to_integer_with_base", "integer_to_string_with_base",
+]
