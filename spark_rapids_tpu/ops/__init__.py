@@ -2,9 +2,15 @@ from .hash import murmur_hash3_32, xxhash64, DEFAULT_XXHASH64_SEED
 from .cast_string import (CastError, string_to_integer, string_to_float,
                           string_to_integer_with_base,
                           integer_to_string_with_base)
+from .cast_decimal import string_to_decimal
+from .decimal_utils import (add_decimal128, sub_decimal128,
+                            multiply_decimal128, divide_decimal128,
+                            remainder_decimal128)
 
 __all__ = [
     "murmur_hash3_32", "xxhash64", "DEFAULT_XXHASH64_SEED",
     "CastError", "string_to_integer", "string_to_float",
     "string_to_integer_with_base", "integer_to_string_with_base",
+    "string_to_decimal", "add_decimal128", "sub_decimal128",
+    "multiply_decimal128", "divide_decimal128", "remainder_decimal128",
 ]

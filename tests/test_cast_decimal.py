@@ -1,0 +1,117 @@
+"""string→decimal tests: golden vectors from the reference's
+tests/cast_string.cpp StringToDecimalTests (cudf scale = -spark scale)."""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import dtypes
+from spark_rapids_tpu.columnar import Column
+from spark_rapids_tpu.ops.cast_decimal import string_to_decimal
+from spark_rapids_tpu.ops.cast_string import CastError
+
+
+def scol(vals):
+    return Column.from_pylist(vals, dtypes.STRING)
+
+
+def run(strings, precision, cudf_scale, **kw):
+    """Mirror the reference signature string_to_decimal(precision, scale)."""
+    return string_to_decimal(scol(strings), precision, -cudf_scale, **kw)
+
+
+def check(r, values, validity):
+    got_valid = np.asarray(r.null_mask)
+    np.testing.assert_array_equal(got_valid, np.array(validity, bool))
+    got = r.to_pylist()
+    for g, v, ok in zip(got, values, validity):
+        if ok:
+            assert g == v, (g, v)
+
+
+class TestStringToDecimal:
+    def test_simple(self):
+        check(run(["1", "0", "-1"], 1, 0), [1, 0, -1], [1, 1, 1])
+
+    def test_over_precise(self):
+        check(run(["123456", "999999", "-123456", "-999999"], 5, 0),
+              [0, 0, 0, 0], [0, 0, 0, 0])
+
+    def test_rounding(self):
+        check(run(["1.23456", "9.99999", "-1.23456", "-9.99999"], 5, -4),
+              [12346, 0, -12346, 0], [1, 0, 1, 0])
+
+    def test_decimal_values(self):
+        check(run(["1.234", "0.12345", "-1.034", "-0.001234567890123456"], 6, -5),
+              [123400, 12345, -103400, -123], [1, 1, 1, 1])
+
+    def test_exponential_notation(self):
+        check(run(["1.234e-1", "0.12345e1", "-1.034e-2",
+                   "-0.001234567890123456e2"], 6, -5),
+              [12340, 123450, -1034, -12346], [1, 1, 1, 1])
+
+    def test_positive_scale(self):
+        check(run(["1234e-1", "12345e1", "-1234.5678",
+                   "-0.001234567890123456e6"], 6, 2),
+              [1, 1235, -12, -12], [1, 1, 1, 1])
+
+    def test_positive_scale_batch(self):
+        strings = ["813847339", "043469773", "548977048", "985946604",
+                   "325679554", "null", "957413342", "541903389", "150050891",
+                   "663968655", "976832602", "757172936", "968693314",
+                   "106046331", "965120263", "354546567", "108127101",
+                   "339513621", "980338159", "593267777"]
+        vals = [813847, 43470, 548977, 985947, 325680, 0, 957413, 541903,
+                150051, 663969, 976833, 757173, 968693, 106046, 965120,
+                354547, 108127, 339514, 980338, 593268]
+        valid = [1, 1, 1, 1, 1, 0, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1]
+        check(run(strings, 8, 3), vals, valid)
+
+    def test_edges(self):
+        # 38-digit decimal128
+        big = (123456789012345678 * 10**15 + 901234567890123) * 100000 + 45601
+        check(run(["123456789012345678901234567890123456.01"], 38, -2),
+              [big], [1])
+        check(run(["8.483315330475049E-4"], 15, -1), [0], [1])
+        check(run(["8.483315330475049E-2"], 15, -1), [1], [1])
+        check(run(["-1.0E14"], 15, -1), [0], [0])       # doesn't fit p15 s-1
+        check(run(["-1.0E14"], 16, -1), [-10**15], [1])
+        check(run(["8.575859E8"], 15, -1), [8575859000], [1])
+        check(run(["10.0"], 3, -1), [100], [1])
+        check(run(["1.7142857343"], 9, -8), [171428573], [1])
+        check(run(["1.71428573437482136712623"], 9, -8), [171428573], [1])
+        check(run(["1.71428573437482136712623"], 9, -9), [0], [0])
+        check(run(["12.345678901"], 9, -8), [0], [0])
+        check(run(["0.12345678901"], 6, -6), [123457], [1])
+        check(run(["1.2345678901"], 6, -6), [0], [0])
+        check(run(["NaN", "inf", "-inf", "0"], 6, 0), [0, 0, 0, 0], [0, 0, 0, 1])
+        check(run(["1234567809"], 8, 3), [1234568], [1])
+        check(run(["4347202159", "4347802159"], 4, 6), [4347, 4348], [1, 1])
+
+    def test_storage_width_by_precision(self):
+        assert run(["1"], 9, 0).dtype.kind == dtypes.Kind.DECIMAL32
+        assert run(["1"], 18, 0).dtype.kind == dtypes.Kind.DECIMAL64
+        assert run(["1"], 38, 0).dtype.kind == dtypes.Kind.DECIMAL128
+
+    def test_grammar_quirks(self):
+        # no digits required; '1e' and '1e+' are fine; '1e5 ' is invalid
+        # (trailing ws rejected inside the exponent state)
+        r = run([".", "+e5", "1e", "1e+", "1e5 ", " 1e5", "1 e5", "1e 5"], 7, -1)
+        np.testing.assert_array_equal(np.asarray(r.null_mask),
+                                      [1, 1, 1, 1, 0, 1, 0, 0])
+        got = r.to_pylist()
+        assert got[0] == 0 and got[1] == 0
+        assert got[2] == 10 and got[3] == 10   # "1" at scale 1
+        assert got[5] == 1000000               # 1e5 at scale 1
+        # at precision 6 scale 1, 1e5 needs 6 integer digits -> invalid
+        assert not np.asarray(run([" 1e5"], 6, -1).null_mask)[0]
+
+    def test_nulls_and_ansi(self):
+        r = run([None, "5"], 6, 0)
+        assert r.to_pylist() == [None, 5]
+        with pytest.raises(CastError) as e:
+            run(["5", "bogus"], 6, 0, ansi_mode=True)
+        assert e.value.row_number == 1
+
+    def test_trailing_ws_after_mantissa(self):
+        r = run(["12 ", "1.5 ", " 8.2  ", "1. ", " 12"], 7, -1)
+        np.testing.assert_array_equal(np.asarray(r.null_mask), [1] * 5)
+        assert r.to_pylist() == [120, 15, 82, 10, 120]

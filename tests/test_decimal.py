@@ -1,0 +1,282 @@
+"""DECIMAL128 arithmetic tests: limb-math primitives vs Python bigints, and
+op-level golden vectors from the reference's DecimalUtilsTest.java (which
+itself uses java BigDecimal / real Spark outputs as oracle)."""
+import decimal
+import random
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import dtypes
+from spark_rapids_tpu.columnar import Column
+from spark_rapids_tpu.ops import decimal256 as d256
+from spark_rapids_tpu.ops.decimal_utils import (
+    add_decimal128, divide_decimal128, multiply_decimal128,
+    remainder_decimal128, sub_decimal128)
+
+
+# ---------------------------------------------------------------------------
+# limb primitives vs Python ints
+# ---------------------------------------------------------------------------
+M256 = (1 << 256) - 1
+
+
+def as_signed256(u):
+    u &= M256
+    return u - (1 << 256) if u >= (1 << 255) else u
+
+
+class TestLimbPrimitives:
+    def test_roundtrip(self):
+        vals = [0, 1, -1, 2**128, -(2**200), (1 << 255) - 1, -(1 << 255)]
+        assert d256.to_int(d256.from_int(vals)) == vals
+
+    def test_add_mul_random(self):
+        rng = random.Random(5)
+        a = [rng.randrange(-(1 << 254), 1 << 254) for _ in range(100)]
+        b = [rng.randrange(-(1 << 254), 1 << 254) for _ in range(100)]
+        A, B = d256.from_int(a), d256.from_int(b)
+        got_add = d256.to_int(d256.add(A, B))
+        got_mul = d256.to_int(d256.multiply(A, B))
+        for i in range(100):
+            assert got_add[i] == as_signed256(a[i] + b[i])
+            assert got_mul[i] == as_signed256(a[i] * b[i])
+
+    def test_negate_abs(self):
+        vals = [5, -5, 0, -(1 << 200)]
+        A = d256.from_int(vals)
+        assert d256.to_int(d256.negate(A)) == [-5, 5, 0, 1 << 200]
+        mag, neg = d256.abs_(A)
+        assert d256.to_int(mag) == [5, 5, 0, 1 << 200]
+        np.testing.assert_array_equal(np.asarray(neg), [False, True, False, True])
+
+    def test_divide_random(self):
+        rng = random.Random(9)
+        cases = []
+        for _ in range(60):
+            n = rng.randrange(-(10**60), 10**60)
+            d = rng.randrange(1, 10**30) * rng.choice([1, -1])
+            cases.append((n, d))
+        cases += [(7, 2), (-7, 2), (7, -2), (-7, -2), (0, 5), (10**70, 3)]
+        N = d256.from_int([c[0] for c in cases])
+        D = d256.from_int([c[1] for c in cases])
+        q, r = d256.divide(N, D)
+        qi, ri = d256.to_int(q), d256.to_int(r)
+        for i, (n, d) in enumerate(cases):
+            # C-style truncating division (quotient toward zero, remainder
+            # takes the dividend's sign)
+            expect_q = abs(n) // abs(d) * (1 if (n < 0) == (d < 0) else -1)
+            expect_r = abs(n) % abs(d) * (1 if n >= 0 else -1)
+            assert qi[i] == expect_q, (n, d)
+            assert ri[i] == expect_r, (n, d)
+
+    def test_divide_and_round_half_up(self):
+        cases = [(5, 2, 3), (-5, 2, -3), (5, -2, -3), (-5, -2, 3),
+                 (4, 2, 2), (7, 3, 2), (8, 3, 3), (-7, 3, -2), (-8, 3, -3)]
+        N = d256.from_int([c[0] for c in cases])
+        D = d256.from_int([c[1] for c in cases])
+        got = d256.to_int(d256.divide_and_round(N, D))
+        for i, (n, d, e) in enumerate(cases):
+            assert got[i] == e, (n, d, got[i])
+
+    def test_precision10(self):
+        vals = [0, 1, 9, 10, 11, 99, 100, 101, 10**38, -(10**38), 10**75]
+        got = np.asarray(d256.precision10(d256.from_int(vals)))
+        # first i with 10^i >= |v| (reference definition)
+        exp = [0, 0, 1, 1, 2, 2, 2, 3, 38, 38, 75]
+        np.testing.assert_array_equal(got, exp)
+
+    def test_overflow_check(self):
+        vals = [10**38 - 1, 10**38, -(10**38 - 1), -(10**38), 0]
+        got = np.asarray(d256.is_greater_than_decimal_38(d256.from_int(vals)))
+        np.testing.assert_array_equal(got, [False, True, False, True, False])
+
+
+# ---------------------------------------------------------------------------
+# op-level golden vectors (DecimalUtilsTest.java)
+# ---------------------------------------------------------------------------
+def dcol(strs):
+    """Build a decimal128 column from decimal strings (uniform scale)."""
+    scales = set()
+    unscaled = []
+    for s in strs:
+        d = decimal.Decimal(s)
+        sign, digits, exp = d.as_tuple()
+        v = int("".join(map(str, digits))) * (-1 if sign else 1)
+        scales.add(-exp)
+        unscaled.append(v)
+    assert len(scales) == 1, f"mixed scales {scales}"
+    scale = scales.pop()
+    return Column.from_pylist(unscaled, dtypes.DType(
+        dtypes.Kind.DECIMAL128, precision=38, scale=scale))
+
+
+def expect(ovf_col, res_col, expected_strs, expected_ovf):
+    np.testing.assert_array_equal(np.asarray(ovf_col.data),
+                                  np.array(expected_ovf, bool))
+    if expected_strs is not None:
+        got = res_col.to_pylist()
+        for i, (g, s) in enumerate(zip(got, expected_strs)):
+            if expected_ovf[i]:
+                continue
+            d = decimal.Decimal(s)
+            sign, digits, exp = d.as_tuple()
+            v = int("".join(map(str, digits))) * (-1 if sign else 1)
+            assert g == v, (i, g, s)
+            assert res_col.dtype.scale == -exp
+
+
+class TestMultiply:
+    def test_one_by_zero_scale(self):
+        o, r = multiply_decimal128(
+            dcol(["1.0", "10.0", "1000000000000000000000000000000000000.0"]),
+            dcol(["1", "1", "1"]), 1)
+        expect(o, r, ["1.0", "10.0", "1000000000000000000000000000000000000.0"],
+               [False] * 3)
+
+    def test_one_by_one(self):
+        o, r = multiply_decimal128(dcol(["1.0", "3.7"]), dcol(["1.0", "1.5"]), 1)
+        expect(o, r, ["1.0", "5.6"], [False, False])
+
+    def test_negative_rhs_scale(self):
+        o, r = multiply_decimal128(dcol(["1"]), dcol(["1e1"]), 1)
+        expect(o, r, ["10.0"], [False])
+
+    def test_without_interim_cast(self):
+        o, r = multiply_decimal128(
+            dcol(["-8533444864753048107770677711.1312637916"]),
+            dcol(["-12.0000000000"]), 6, cast_interim_result=False)
+        expect(o, r, ["102401338377036577293248132533.575165"], [False])
+
+    def test_large_ten_by_ten(self):
+        o, r = multiply_decimal128(
+            dcol(["577694940161436285811555447.3103121126"]),
+            dcol(["100.0000000000"]), 6)
+        expect(o, r, ["57769494016143628581155544731.031211"], [False])
+
+    def test_overflow(self):
+        o, r = multiply_decimal128(
+            dcol(["577694938495380589068894346.7625198736"]),
+            dcol(["-1258508260891400005608241690.1564700995"]), 6)
+        expect(o, r, None, [True])
+
+    def test_spark_compat_interim_rounding(self):
+        """Spark SPARK-40129 bug-compatible values (not plain BigDecimal)."""
+        o, r = multiply_decimal128(
+            dcol(["3358377338823096511784947656.4650294583",
+                  "7161021785186010157110137546.5940777916",
+                  "9173594185998001607642838421.5479932913"]),
+            dcol(["-12.0000000000", "-12.0000000000", "-12.0000000000"]), 6)
+        expect(o, r, ["-40300528065877158141419371877.580354",
+                      "-85932261422232121885321650559.128933",
+                      "-110083130231976019291714061058.575920"], [False] * 3)
+
+
+class TestDivide:
+    def test_simple(self):
+        o, r = divide_decimal128(
+            dcol(["1.0", "10.0", "1.0", "1000000000000000000000000000000000000.0"]),
+            dcol(["1", "2", "0", "5"]), 1)
+        expect(o, r, ["1.0", "5.0", "0", "200000000000000000000000000000000000.0"],
+               [False, False, True, False])
+
+    def test_signs(self):
+        o, r = divide_decimal128(dcol(["1.0", "-3.7", "-99.9"]),
+                                 dcol(["-1.0", "1.5", "-4.5"]), 1)
+        expect(o, r, ["-1.0", "-2.5", "22.2"], [False] * 3)
+
+    def test_complex_deep_shift(self):
+        # n_shift_exp = -43 < -38: the base-10^38 long-division path
+        o, r = divide_decimal128(dcol(["100000000000000000000000000000000"]),
+                                 dcol(["3.0000000000000000000000000000000000000"]), 6)
+        expect(o, r, ["33333333333333333333333333333333.333333"], [False])
+
+    def test_div17(self):
+        o, r = divide_decimal128(
+            dcol(["1454.48287885760884146", "3655.54438423288356646"]),
+            dcol(["100.00000000000000000", "100.00000000000000000"]), 17)
+        expect(o, r, ["14.54482878857608841", "36.55544384232883566"], [False] * 2)
+
+    def test_div21(self):
+        o, r = divide_decimal128(
+            dcol(["60250054953505368.439892586764888491018",
+                  "91910085134512953.335347579448489062875",
+                  "51312633107598808.869351260608653423886"]),
+            dcol(["97982875273794447.385070145919990343867",
+                  "94478503341597285.814104936062234698349",
+                  "92266075543848323.800466593082956765923"]), 6)
+        expect(o, r, ["0.614904", "0.972815", "0.556138"], [False] * 3)
+
+    def test_int_divide(self):
+        o, r = divide_decimal128(
+            dcol(["3396191716868766147341919609.06",
+                  "-6893798181986328848375556144.67"]),
+            dcol(["7317548469.64", "98565515088.44"]), 0, is_int_div=True)
+        np.testing.assert_array_equal(np.asarray(o.data), [False, False])
+        assert r.to_pylist() == [464116053478747633, -69941278912819784]
+
+    def test_int_divide_truncation_not_flagged(self):
+        """Spark judges overflow on the 128-bit value, not the long result."""
+        o, r = divide_decimal128(
+            dcol(["451635271134476686911387864.48",
+                  "5313675970270560086329837153.18"]),
+            dcol(["-961.110", "181.958"]), 0, is_int_div=True)
+        np.testing.assert_array_equal(np.asarray(o.data), [False, False])
+        assert r.to_pylist() == [2284624887606872042, -2928582767902049472]
+
+    def test_int_divide_by_zero(self):
+        o, r = divide_decimal128(
+            dcol(["-999999999999999999999999999999999999.99",
+                  "999999999999999999999999999999999999.99"]),
+            dcol(["0", "0"]), 0, is_int_div=True)
+        np.testing.assert_array_equal(np.asarray(o.data), [True, True])
+
+
+class TestAddSubRemainder:
+    def test_add_overflow(self):
+        o, r = add_decimal128(
+            dcol(["9191008513307131620269245301.1615457290",
+                  "-9191008513307131620269245301.1615457290"]),
+            dcol(["9447850332473678680446404122.5624623187",
+                  "-9447850332473678680446404122.5624623187"]), 10)
+        expect(o, r, None, [True, True])
+
+    def test_add_simple(self):
+        o, r = add_decimal128(dcol(["1.5", "-2.5"]), dcol(["2.5", "0.5"]), 1)
+        expect(o, r, ["4.0", "-2.0"], [False, False])
+
+    def test_add_different_scales(self):
+        o, r = add_decimal128(dcol(["1.50"]), dcol(["2.5555"]), 4)
+        expect(o, r, ["4.0555"], [False])
+
+    def test_sub(self):
+        o, r = sub_decimal128(dcol(["5.0"]), dcol(["7.5"]), 1)
+        expect(o, r, ["-2.5"], [False])
+
+    def test_remainder(self):
+        o, r = remainder_decimal128(
+            dcol(["2775750723350045263458396405825339066",
+                  "2775750723350045263458396405825339066",
+                  "-2775750723350045263458396405825339066",
+                  "-2775750723350045263458396405825339066"]),
+            dcol(["-4890990637589340307512622401149178814.1",
+                  "4890990637589340307512622401149178814.1",
+                  "-4890990637589340307512622401149178814.1",
+                  "4890990637589340307512622401149178814.1"]), 1)
+        expect(o, r, ["2775750723350045263458396405825339066.0",
+                      "2775750723350045263458396405825339066.0",
+                      "-2775750723350045263458396405825339066.0",
+                      "-2775750723350045263458396405825339066.0"], [False] * 4)
+
+    def test_remainder_small(self):
+        o, r = remainder_decimal128(dcol(["7.0", "-7.0", "7.0", "-7.0"]),
+                                    dcol(["2.0", "2.0", "-2.0", "-2.0"]), 1)
+        expect(o, r, ["1.0", "-1.0", "1.0", "-1.0"], [False] * 4)
+
+    def test_nulls_propagate(self):
+        a = Column.from_pylist([10, None], dtypes.DType(
+            dtypes.Kind.DECIMAL128, precision=38, scale=1))
+        b = Column.from_pylist([None, 20], dtypes.DType(
+            dtypes.Kind.DECIMAL128, precision=38, scale=1))
+        o, r = add_decimal128(a, b, 1)
+        assert r.to_pylist() == [None, None]
