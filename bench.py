@@ -1,0 +1,74 @@
+"""Headline benchmark: Spark-exact row hashing throughput on device.
+
+Hashing (murmur3_32 + xxhash64 over a 2×int64-column table) is the kernel a
+Spark plan leans on hardest — every hash partition, hash join and hash
+aggregate runs it over the full batch. The reference measures its kernels with
+nvbench locally and publishes nothing (SURVEY.md §6), so the baseline here is
+the same XLA program on the host CPU: `vs_baseline` = device rows/s ÷ host
+rows/s.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import time
+
+import numpy as np
+
+
+def _bench(fn, args, iters=20):
+    import jax
+    out = fn(*args)           # warmup/compile
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu import dtypes, Column
+    from spark_rapids_tpu.columnar import Table
+    from spark_rapids_tpu.ops import murmur_hash3_32, xxhash64
+
+    n = 10_000_000
+    rng = np.random.default_rng(0)
+    keys_np = rng.integers(-(2**62), 2**62, size=n, dtype=np.int64)
+    vals_np = rng.integers(-(2**31), 2**31, size=n, dtype=np.int64)
+
+    def step(keys, vals):
+        t = Table([Column(dtype=dtypes.INT64, length=n, data=keys),
+                   Column(dtype=dtypes.INT64, length=n, data=vals)])
+        h32 = murmur_hash3_32(t, seed=42)
+        h64 = xxhash64(t)
+        return h32.data, h64.data
+
+    jit_step = jax.jit(step)
+
+    dev = jax.devices()[0]
+    d_args = (jax.device_put(jnp.asarray(keys_np), dev),
+              jax.device_put(jnp.asarray(vals_np), dev))
+    dev_s = _bench(jit_step, d_args)
+    dev_rows_per_s = n / dev_s
+
+    try:
+        cpu = jax.devices("cpu")[0]
+        c_args = (jax.device_put(jnp.asarray(keys_np), cpu),
+                  jax.device_put(jnp.asarray(vals_np), cpu))
+        cpu_s = _bench(jit_step, c_args, iters=3)
+        vs_baseline = dev_rows_per_s / (n / cpu_s)
+    except Exception:
+        vs_baseline = None  # baseline did not run; distinct from measured 1.0
+
+    print(json.dumps({
+        "metric": "spark_row_hash_throughput",
+        "value": round(dev_rows_per_s / 1e6, 3),
+        "unit": "Mrows/s (murmur3_32+xxhash64, 2xint64, 10M rows)",
+        "vs_baseline": None if vs_baseline is None else round(vs_baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+from .shuffle import (partition_ids, build_partition_map, exchange,
+                      repartition_table, make_mesh)
+
+__all__ = ["partition_ids", "build_partition_map", "exchange",
+           "repartition_table", "make_mesh"]
