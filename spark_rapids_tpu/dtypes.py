@@ -26,6 +26,7 @@ import jax.numpy as jnp
 class Kind(enum.Enum):
     BOOL = "bool"
     INT8 = "int8"
+    UINT8 = "uint8"            # binary payloads (LIST<UINT8> rows)
     INT16 = "int16"
     INT32 = "int32"
     INT64 = "int64"
@@ -37,6 +38,8 @@ class Kind(enum.Enum):
     STRING = "string"
     DATE32 = "date32"          # days since 1970-01-01 (Spark DateType)
     TIMESTAMP_US = "timestamp" # microseconds since epoch (Spark TimestampType)
+    TIMESTAMP_S = "timestamp_s"    # seconds since epoch
+    TIMESTAMP_MS = "timestamp_ms"  # milliseconds since epoch
     LIST = "list"
     STRUCT = "struct"
 
@@ -83,6 +86,7 @@ class DType:
         return {
             Kind.BOOL: jnp.bool_,
             Kind.INT8: jnp.int8,
+            Kind.UINT8: jnp.uint8,
             Kind.INT16: jnp.int16,
             Kind.INT32: jnp.int32,
             Kind.INT64: jnp.int64,
@@ -94,15 +98,18 @@ class DType:
             Kind.STRING: jnp.uint8,        # chars buffer
             Kind.DATE32: jnp.int32,
             Kind.TIMESTAMP_US: jnp.int64,
+            Kind.TIMESTAMP_S: jnp.int64,
+            Kind.TIMESTAMP_MS: jnp.int64,
         }[self.kind]
 
     def itemsize(self) -> int:
         """Bytes per row of the primary buffer (Spark row-format width)."""
         return {
-            Kind.BOOL: 1, Kind.INT8: 1, Kind.INT16: 2, Kind.INT32: 4,
+            Kind.BOOL: 1, Kind.INT8: 1, Kind.UINT8: 1, Kind.INT16: 2, Kind.INT32: 4,
             Kind.INT64: 8, Kind.FLOAT32: 4, Kind.FLOAT64: 8,
             Kind.DECIMAL32: 4, Kind.DECIMAL64: 8, Kind.DECIMAL128: 16,
             Kind.DATE32: 4, Kind.TIMESTAMP_US: 8,
+            Kind.TIMESTAMP_S: 8, Kind.TIMESTAMP_MS: 8,
         }[self.kind]
 
     def __repr__(self):
@@ -119,6 +126,7 @@ class DType:
 # Singletons for the common scalar types.
 BOOL = DType(Kind.BOOL)
 INT8 = DType(Kind.INT8)
+UINT8 = DType(Kind.UINT8)
 INT16 = DType(Kind.INT16)
 INT32 = DType(Kind.INT32)
 INT64 = DType(Kind.INT64)
@@ -127,6 +135,8 @@ FLOAT64 = DType(Kind.FLOAT64)
 STRING = DType(Kind.STRING)
 DATE32 = DType(Kind.DATE32)
 TIMESTAMP_US = DType(Kind.TIMESTAMP_US)
+TIMESTAMP_S = DType(Kind.TIMESTAMP_S)
+TIMESTAMP_MS = DType(Kind.TIMESTAMP_MS)
 
 
 def decimal(precision: int, scale: int) -> DType:

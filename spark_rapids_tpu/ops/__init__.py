@@ -6,6 +6,13 @@ from .cast_decimal import string_to_decimal
 from .decimal_utils import (add_decimal128, sub_decimal128,
                             multiply_decimal128, divide_decimal128,
                             remainder_decimal128)
+from .cast_decimal_to_string import decimal_to_non_ansi_string
+from .zorder import interleave_bits, hilbert_index
+from .datetime_rebase import (rebase_gregorian_to_julian,
+                              rebase_julian_to_gregorian)
+from .bloom_filter import (BloomFilter, bloom_filter_create, bloom_filter_put,
+                           bloom_filter_merge, bloom_filter_probe,
+                           bloom_filter_serialize, bloom_filter_deserialize)
 
 __all__ = [
     "murmur_hash3_32", "xxhash64", "DEFAULT_XXHASH64_SEED",
@@ -13,4 +20,9 @@ __all__ = [
     "string_to_integer_with_base", "integer_to_string_with_base",
     "string_to_decimal", "add_decimal128", "sub_decimal128",
     "multiply_decimal128", "divide_decimal128", "remainder_decimal128",
+    "decimal_to_non_ansi_string", "interleave_bits", "hilbert_index",
+    "rebase_gregorian_to_julian", "rebase_julian_to_gregorian",
+    "BloomFilter", "bloom_filter_create", "bloom_filter_put",
+    "bloom_filter_merge", "bloom_filter_probe", "bloom_filter_serialize",
+    "bloom_filter_deserialize",
 ]

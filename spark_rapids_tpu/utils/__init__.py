@@ -1,0 +1,3 @@
+from .bitmask import pack_validity, unpack_validity, bitmask_bitwise_or
+
+__all__ = ["pack_validity", "unpack_validity", "bitmask_bitwise_or"]

@@ -1,0 +1,129 @@
+"""Bloom filter tests against a pure-Python Spark BloomFilterImpl oracle
+(same role as the reference's BloomFilterTest.java:42-185, which probes
+GPU-built filters against Spark-serialized buffers)."""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import dtypes
+from spark_rapids_tpu.columnar import Column
+from spark_rapids_tpu.ops.bloom_filter import (
+    BloomFilter, bloom_filter_create, bloom_filter_put, bloom_filter_merge,
+    bloom_filter_probe, bloom_filter_serialize, bloom_filter_deserialize)
+
+from spark_hash_oracle import murmur32_bytes, encode_int8
+
+
+def _to_i32(v):
+    v &= 0xFFFFFFFF
+    return v - (1 << 32) if v >= (1 << 31) else v
+
+
+class SparkBloomOracle:
+    """Pure-Python BloomFilterImpl: BitArray of longs + double hashing."""
+
+    def __init__(self, num_hashes, num_longs):
+        self.k = num_hashes
+        self.longs = [0] * num_longs
+        self.num_bits = num_longs * 64
+
+    def _indexes(self, item):
+        h1 = murmur32_bytes(encode_int8(item), 0)
+        h2 = murmur32_bytes(encode_int8(item), h1 & 0xFFFFFFFF)
+        out = []
+        for i in range(1, self.k + 1):
+            combined = _to_i32(h1 + i * h2)
+            if combined < 0:
+                combined = ~combined
+            out.append(combined % self.num_bits)
+        return out
+
+    def put(self, item):
+        for idx in self._indexes(item):
+            self.longs[idx >> 6] |= (1 << (idx & 63))
+
+    def might_contain(self, item):
+        return all(self.longs[i >> 6] & (1 << (i & 63)) for i in self._indexes(item))
+
+    def serialize(self) -> bytes:
+        out = (1).to_bytes(4, "big") + self.k.to_bytes(4, "big") + \
+            len(self.longs).to_bytes(4, "big")
+        for v in self.longs:
+            out += (v & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "big")
+        return out
+
+
+def _col(vals):
+    return Column.from_pylist(vals, dtypes.INT64)
+
+
+def test_wire_format_matches_spark():
+    rng = np.random.default_rng(0)
+    vals = [int(v) for v in rng.integers(-(2**62), 2**62, size=200)]
+    oracle = SparkBloomOracle(3, 8)
+    for v in vals:
+        oracle.put(v)
+    bf = bloom_filter_put(bloom_filter_create(3, 8), _col(vals))
+    got = bytes(np.asarray(bloom_filter_serialize(bf)))
+    assert got == oracle.serialize()
+
+
+def test_probe_matches_oracle():
+    rng = np.random.default_rng(1)
+    put_vals = [int(v) for v in rng.integers(-(2**40), 2**40, size=500)]
+    probe_vals = put_vals[:100] + [int(v) for v in rng.integers(2**41, 2**42, size=200)]
+    oracle = SparkBloomOracle(5, 64)
+    for v in put_vals:
+        oracle.put(v)
+    bf = bloom_filter_put(bloom_filter_create(5, 64), _col(put_vals))
+    got = bloom_filter_probe(_col(probe_vals), bf).to_pylist()
+    want = [oracle.might_contain(v) for v in probe_vals]
+    assert got == want
+    assert all(got[:100])  # no false negatives ever
+
+
+def test_deserialize_spark_buffer_and_probe():
+    oracle = SparkBloomOracle(4, 16)
+    for v in [1, 2, 3, 1000, -5_000_000_000]:
+        oracle.put(v)
+    bf = bloom_filter_deserialize(np.frombuffer(oracle.serialize(), np.uint8))
+    assert bf.num_hashes == 4 and bf.num_longs == 16
+    got = bloom_filter_probe(_col([1, 2, 3, 1000, -5_000_000_000, 77]), bf).to_pylist()
+    assert got[:5] == [True] * 5
+    assert got[5] == oracle.might_contain(77)
+
+
+def test_serialize_roundtrip():
+    bf = bloom_filter_put(bloom_filter_create(2, 4), _col([10, 20, 30]))
+    rt = bloom_filter_deserialize(np.asarray(bloom_filter_serialize(bf)))
+    assert np.array_equal(np.asarray(rt.bits), np.asarray(bf.bits))
+
+
+def test_merge():
+    a = bloom_filter_put(bloom_filter_create(3, 8), _col([1, 2, 3]))
+    b = bloom_filter_put(bloom_filter_create(3, 8), _col([100, 200]))
+    m = bloom_filter_merge([a, b])
+    got = bloom_filter_probe(_col([1, 2, 3, 100, 200]), m).to_pylist()
+    assert got == [True] * 5
+    with pytest.raises(ValueError):
+        bloom_filter_merge([a, bloom_filter_create(2, 8)])
+    with pytest.raises(ValueError):
+        bloom_filter_merge([a, bloom_filter_create(3, 4)])
+
+
+def test_nulls_skipped_on_put_pass_through_on_probe():
+    bf = bloom_filter_put(bloom_filter_create(3, 8), _col([1, None, 3]))
+    oracle = SparkBloomOracle(3, 8)
+    oracle.put(1)
+    oracle.put(3)
+    assert bytes(np.asarray(bloom_filter_serialize(bf))) == oracle.serialize()
+    got = bloom_filter_probe(_col([1, None]), bf).to_pylist()
+    assert got == [True, None]
+
+
+def test_deserialize_validation():
+    with pytest.raises(ValueError):
+        bloom_filter_deserialize(np.zeros(4, np.uint8))
+    bad_version = (9).to_bytes(4, "big") + (1).to_bytes(4, "big") + \
+        (1).to_bytes(4, "big") + b"\x00" * 8
+    with pytest.raises(ValueError):
+        bloom_filter_deserialize(np.frombuffer(bad_version, np.uint8))

@@ -1,0 +1,91 @@
+"""decimal→string tests. Oracle: Python Decimal.__str__, which implements the
+same algorithm as java.math.BigDecimal.toString (both follow the General
+Decimal Arithmetic to-scientific-string rules the reference kernel encodes,
+cast_decimal_to_string.cu:53-175) — modulo Python using 'E+x' lowercase 'e';
+we normalize the oracle to Java's formatting."""
+import decimal
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import dtypes
+from spark_rapids_tpu.columnar import Column
+from spark_rapids_tpu.ops.cast_decimal_to_string import decimal_to_non_ansi_string
+
+
+def java_bigdecimal_str(unscaled: int, scale: int) -> str:
+    """BigDecimal(unscaled, scale).toString() oracle via python Decimal.
+    Tuple construction is exact (no context rounding, unlike scaleb)."""
+    digits = tuple(int(c) for c in str(abs(unscaled)))
+    d = decimal.Decimal((0 if unscaled >= 0 else 1, digits, -scale))
+    # Python prints exponent as E+26/E-7 like Java; ensure uppercase
+    return str(d).upper()
+
+
+def check(unscaled_values, precision, scale):
+    dt = dtypes.decimal(precision, scale)
+    col = Column.from_pylist(unscaled_values, dt)
+    got = decimal_to_non_ansi_string(col).to_pylist()
+    want = [None if v is None else java_bigdecimal_str(v, scale)
+            for v in unscaled_values]
+    assert got == want, f"precision={precision} scale={scale}"
+
+
+def test_zero_scale_plain():
+    check([0, 1, -1, 123456789, -123456789, None], 9, 0)
+
+
+def test_positive_scale_plain():
+    check([0, 5, -5, 12345, -12345, 100, 99999], 9, 2)
+    check([0, 5, 123, 100000], 9, 5)
+
+
+def test_fraction_leading_zeros():
+    # |v| < 10^scale → "0.0...d"
+    check([1, 7, 10, 99, -1], 9, 6)
+
+
+def test_scientific_small_adjusted_exponent():
+    # adjusted exponent < -6 → scientific (e.g. unscaled 1 at scale 8 = 1E-8)
+    check([1, -1, 12, 123], 18, 8)
+    check([1], 18, 18)
+
+
+def test_decimal64_range():
+    check([999999999999999999, -999999999999999999, 1, 0], 18, 4)
+
+
+def test_decimal128():
+    vals = [0, 1, -1, 10**37, -(10**37), 12345678901234567890123456789012345678,
+            -12345678901234567890123456789012345678, None]
+    check(vals, 38, 0)
+    check(vals, 38, 10)
+    check([1, -1, 99, 10**20], 38, 30)
+
+
+def test_decimal128_all_scales_random():
+    rng = np.random.default_rng(0)
+    for scale in (0, 1, 7, 19, 37):
+        vals = [int(rng.integers(-10**12, 10**12)) * 10**int(rng.integers(0, 20))
+                for _ in range(50)]
+        check(vals, 38, scale)
+
+
+def test_rejects_non_decimal():
+    with pytest.raises(TypeError):
+        decimal_to_non_ansi_string(Column.from_pylist([1], dtypes.INT32))
+
+
+def test_bitmask_utils_roundtrip():
+    import jax.numpy as jnp
+    from spark_rapids_tpu.utils import (pack_validity, unpack_validity,
+                                        bitmask_bitwise_or)
+    rng = np.random.default_rng(1)
+    v = rng.random(37) < 0.5
+    packed = pack_validity(jnp.asarray(v))
+    assert packed.shape[0] == 5
+    assert np.asarray(unpack_validity(packed, 37)).tolist() == v.tolist()
+    a = pack_validity(jnp.asarray(np.array([True, False, False])))
+    b = pack_validity(jnp.asarray(np.array([False, False, True])))
+    merged = bitmask_bitwise_or([a, b])
+    assert np.asarray(unpack_validity(merged, 3)).tolist() == [True, False, True]
