@@ -1,0 +1,40 @@
+"""Build the native runtime core on demand.
+
+The reference builds its native substrate as one static-linked .so through a
+Maven→Ant→CMake pipeline (SURVEY.md §2.3 "Build pipeline"); here the native
+surface is small enough that a direct g++ invocation, cached by source mtime,
+keeps the repo self-contained and hermetic (no network, no generators). The
+.so is rebuilt automatically whenever a source file changes.
+"""
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+
+_SOURCES = {
+    "resource_adaptor": ["resource_adaptor.cpp"],
+    "parquet_footer": ["parquet_footer.cpp"],
+}
+
+
+def lib_path(name: str) -> str:
+    return os.path.join(_HERE, f"lib{name}.so")
+
+
+def build(name: str) -> str:
+    """Compile lib<name>.so from its sources if stale; return its path."""
+    srcs = [os.path.join(_HERE, s) for s in _SOURCES[name]]
+    out = lib_path(name)
+    with _LOCK:
+        if os.path.exists(out) and all(
+                os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs):
+            return out
+        cmd = ["g++", "-std=c++17", "-O2", "-g", "-fPIC", "-shared", "-pthread",
+               "-Wall", "-Wextra", "-o", out] + srcs
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native build of {name} failed:\n{proc.stderr}")
+        return out

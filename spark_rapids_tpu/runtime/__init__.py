@@ -1,0 +1,25 @@
+"""Host-side runtime: task/memory arbitration for a shared TPU device.
+
+Native C++ state machine (native/resource_adaptor.cpp) + Python facade.
+See SURVEY.md §2.2 — this is the reference's largest single component.
+"""
+from .adaptor import (ResourceArbiter, OomInjectionType, current_thread_id,
+                      ArbiterOOM, RetryOOM, SplitAndRetryOOM, CpuRetryOOM,
+                      CpuSplitAndRetryOOM, HardOOM, InjectedException,
+                      ThreadRemovedError,
+                      STATE_UNKNOWN, STATE_RUNNING, STATE_ALLOC,
+                      STATE_ALLOC_FREE, STATE_BLOCKED, STATE_BUFN_THROW,
+                      STATE_BUFN_WAIT, STATE_BUFN, STATE_SPLIT_THROW,
+                      STATE_REMOVE_THROW, STATE_NAMES)
+from .pool import MemoryBudget, DeviceSession, Reservation
+from .retry import with_retry
+
+__all__ = [
+    "ResourceArbiter", "OomInjectionType", "current_thread_id",
+    "ArbiterOOM", "RetryOOM", "SplitAndRetryOOM", "CpuRetryOOM",
+    "CpuSplitAndRetryOOM", "HardOOM", "InjectedException", "ThreadRemovedError",
+    "MemoryBudget", "DeviceSession", "Reservation", "with_retry",
+    "STATE_UNKNOWN", "STATE_RUNNING", "STATE_ALLOC", "STATE_ALLOC_FREE",
+    "STATE_BLOCKED", "STATE_BUFN_THROW", "STATE_BUFN_WAIT", "STATE_BUFN",
+    "STATE_SPLIT_THROW", "STATE_REMOVE_THROW", "STATE_NAMES",
+]

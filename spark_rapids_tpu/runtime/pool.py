@@ -1,0 +1,125 @@
+"""Reservation-based HBM/host-memory admission, arbitrated per task.
+
+The reference wraps rmm's device allocator and catches the synchronous
+cudaMalloc failure (`do_allocate` loop, SparkResourceAdaptorJni.cpp:1733-1754).
+XLA dispatch is asynchronous, so the TPU-native design reserves budget
+*before* dispatching work (SURVEY.md §7 step 4: "reservation-based admission
+(acquire budget before dispatch) rather than catch-and-retry at malloc time")
+while keeping the same observable retry contract: a reservation that doesn't
+fit behaves exactly like a failed cudaMalloc — the thread blocks, retries
+when memory frees, and escalates to RetryOOM/SplitAndRetryOOM on deadlock.
+
+`MemoryBudget` is one budget (device HBM or host off-heap); tests use small
+budgets the way the reference tests use `setupRmmForTestingWithLimits` and
+`LimitingOffHeapAllocForTests` (RmmSparkTest.java) — no real exhaustion
+needed.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from .adaptor import ResourceArbiter, HardOOM
+
+
+@dataclass
+class Reservation:
+    """A live memory reservation; free via MemoryBudget.release()."""
+    nbytes: int
+    is_cpu: bool
+    _released: bool = False
+
+
+class MemoryBudget:
+    """A byte budget for one memory space, fronted by the arbiter.
+
+    acquire() runs the reference's do_allocate loop shape: pre_alloc (may
+    block / raise retry-split) → try reserve → post_alloc_success, or
+    post_alloc_failed → loop. release() mirrors do_deallocate: give the bytes
+    back, then notify the arbiter so blocked threads wake.
+    """
+
+    def __init__(self, arbiter: ResourceArbiter, limit_bytes: int, is_cpu: bool = False):
+        self.arbiter = arbiter
+        self.limit = int(limit_bytes)
+        self.is_cpu = is_cpu
+        self._used = 0
+        self._mu = threading.Lock()
+
+    @property
+    def used(self) -> int:
+        with self._mu:
+            return self._used
+
+    @property
+    def available(self) -> int:
+        with self._mu:
+            return self.limit - self._used
+
+    def _try_reserve(self, nbytes: int) -> bool:
+        with self._mu:
+            if self._used + nbytes > self.limit:
+                return False
+            self._used += nbytes
+            return True
+
+    def acquire(self, nbytes: int) -> Reservation:
+        """Blocking reservation: loops pre→reserve→post like the reference's
+        do_allocate (SparkResourceAdaptorJni.cpp:1733-1754)."""
+        nbytes = int(nbytes)
+        if nbytes > self.limit:
+            # can never fit: even infinite retries won't help
+            raise HardOOM(f"reservation of {nbytes} exceeds budget {self.limit}")
+        while True:
+            r = self._attempt(nbytes, blocking=True)
+            if r is not None:
+                return r
+
+    def try_acquire(self, nbytes: int) -> Optional[Reservation]:
+        """Non-blocking: one attempt; None on failure (the reference's
+        tryAlloc path — LimitingOffHeapAllocForTests.java)."""
+        return self._attempt(int(nbytes), blocking=False)
+
+    def _attempt(self, nbytes: int, blocking: bool) -> Optional[Reservation]:
+        recursive = self.arbiter.pre_alloc(is_cpu=self.is_cpu, blocking=blocking)
+        ok = self._try_reserve(nbytes)
+        if ok:
+            self.arbiter.post_alloc_success(is_cpu=self.is_cpu, was_recursive=recursive)
+            return Reservation(nbytes=nbytes, is_cpu=self.is_cpu)
+        retry = self.arbiter.post_alloc_failed(
+            is_cpu=self.is_cpu, was_oom=True, blocking=blocking, was_recursive=recursive)
+        if blocking and not retry:
+            raise HardOOM(f"allocation of {nbytes} failed and retry is not possible")
+        return None
+
+    def release(self, r: Reservation) -> None:
+        with self._mu:
+            if r._released:
+                return
+            r._released = True
+            self._used -= r.nbytes
+        if r.nbytes > 0:
+            self.arbiter.dealloc(is_cpu=self.is_cpu)
+
+
+class DeviceSession:
+    """Process-wide pair of budgets (device HBM + host off-heap) and the
+    arbiter that coordinates them — the TPU analogue of
+    `Rmm.initialize + RmmSpark.setEventHandler` at executor startup
+    (SURVEY.md §3.3)."""
+
+    def __init__(self, device_limit_bytes: int, host_limit_bytes: int = 0,
+                 log_loc: Optional[str] = None, watchdog: bool = True):
+        self.arbiter = ResourceArbiter(log_loc=log_loc, watchdog=watchdog)
+        self.device = MemoryBudget(self.arbiter, device_limit_bytes, is_cpu=False)
+        self.host = MemoryBudget(self.arbiter, host_limit_bytes, is_cpu=True)
+
+    def close(self):
+        self.arbiter.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,553 @@
+"""Tests for the task/memory arbitration state machine.
+
+Ports the reference's RmmSparkTest scenarios (RmmSparkTest.java — SURVEY.md
+§4 tier 2 "State-machine tests"): plain threads act as Spark tasks against a
+small memory budget (their setupRmmForTestingWithLimits /
+LimitingOffHeapAllocForTests pattern), with OOM injection driving the paths
+real exhaustion would. No JAX needed — this layer is pure host scheduling.
+"""
+import threading
+import time
+import queue
+
+import pytest
+
+from spark_rapids_tpu.runtime import (
+    ResourceArbiter, DeviceSession, MemoryBudget, OomInjectionType,
+    RetryOOM, SplitAndRetryOOM, CpuRetryOOM, CpuSplitAndRetryOOM,
+    HardOOM, InjectedException, with_retry,
+    STATE_RUNNING, STATE_BLOCKED, STATE_BUFN, STATE_BUFN_WAIT,
+)
+
+MiB = 1024 * 1024
+
+
+class TaskActor:
+    """A controllable task thread (the reference's TaskThread,
+    RmmSparkTest.java:64-301): submit closures, poll observed state."""
+
+    def __init__(self, session, task_id=None, shuffle=False):
+        self.session = session
+        self.task_id = task_id
+        self.shuffle = shuffle
+        self.thread_id = None
+        self._q = queue.Queue()
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        assert self._ready.wait(5)
+        return self
+
+    def _run(self):
+        from spark_rapids_tpu.runtime import current_thread_id
+        self.thread_id = current_thread_id()
+        arb = self.session.arbiter
+        if self.shuffle:
+            arb.shuffle_thread_working_on_tasks([], thread_id=self.thread_id)
+        else:
+            arb.current_thread_is_dedicated_to_task(self.task_id)
+        self._ready.set()
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, fut = item
+            try:
+                fut["value"] = fn()
+            except BaseException as e:  # noqa: BLE001 - relayed to the test
+                fut["error"] = e
+            finally:
+                fut["done"].set()
+
+    def submit(self, fn):
+        fut = {"done": threading.Event()}
+        self._q.put((fn, fut))
+        return fut
+
+    def run(self, fn, timeout=10):
+        fut = self.submit(fn)
+        assert fut["done"].wait(timeout), "task actor timed out"
+        if "error" in fut:
+            raise fut["error"]
+        return fut["value"]
+
+    def poll_for_state(self, state, timeout=2.0):
+        arb = self.session.arbiter
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if arb.get_state_of(self.thread_id) == state:
+                return
+            time.sleep(0.002)
+        raise AssertionError(
+            f"thread never reached {state}; at "
+            f"{arb.get_state_name_of(self.thread_id)}")
+
+    def done(self):
+        if self.task_id is not None:
+            self.session.arbiter.task_done(self.task_id)
+        self._q.put(None)
+        self._thread.join(timeout=5)
+
+
+@pytest.fixture()
+def session():
+    with DeviceSession(10 * MiB, host_limit_bytes=10 * MiB) as s:
+        yield s
+
+
+def alloc_on(actor, budget, nbytes):
+    """Start an allocation on the actor's thread; returns the future + a
+    one-slot box that will hold the Reservation."""
+    box = {}
+
+    def go():
+        box["r"] = budget.acquire(nbytes)
+        return box["r"]
+
+    return actor.submit(go), box
+
+
+def test_basic_init_and_teardown():
+    with DeviceSession(10 * MiB):
+        pass
+
+
+def test_state_of_unregistered(session):
+    assert session.arbiter.get_state_of(999999) == -1
+
+
+def test_basic_blocking(session):
+    # RmmSparkTest.testBasicBlocking: second task blocks on a full budget and
+    # wakes when the first frees.
+    one = TaskActor(session, task_id=1).start()
+    two = TaskActor(session, task_id=2).start()
+    try:
+        assert session.arbiter.get_state_of(one.thread_id) == STATE_RUNNING
+        assert session.arbiter.get_state_of(two.thread_id) == STATE_RUNNING
+
+        r1 = one.run(lambda: session.device.acquire(5 * MiB))
+        fut2, box2 = alloc_on(two, session.device, 6 * MiB)
+        two.poll_for_state(STATE_BLOCKED)
+
+        one.run(lambda: session.device.release(r1))
+        assert fut2["done"].wait(5)
+        assert "error" not in fut2
+        two.run(lambda: session.device.release(box2["r"]))
+    finally:
+        one.done()
+        two.done()
+
+
+def test_basic_cpu_blocking(session):
+    one = TaskActor(session, task_id=1).start()
+    two = TaskActor(session, task_id=2).start()
+    try:
+        r1 = one.run(lambda: session.host.acquire(5 * MiB))
+        fut2, box2 = alloc_on(two, session.host, 6 * MiB)
+        two.poll_for_state(STATE_BLOCKED)
+        one.run(lambda: session.host.release(r1))
+        assert fut2["done"].wait(5)
+        two.run(lambda: session.host.release(box2["r"]))
+    finally:
+        one.done()
+        two.done()
+
+
+def test_basic_mixed_blocking(session):
+    # RmmSparkTest.testBasicMixedBlocking: wakeups track the memory *space*
+    # that was freed, not global priority.
+    actors = [TaskActor(session, task_id=i).start() for i in (1, 2, 3, 4)]
+    one, two, three, four = actors
+    try:
+        r_gpu = one.run(lambda: session.device.acquire(5 * MiB))
+        r_cpu = two.run(lambda: session.host.acquire(5 * MiB))
+
+        fut3, box3 = alloc_on(three, session.device, 6 * MiB)
+        three.poll_for_state(STATE_BLOCKED)
+        fut4, box4 = alloc_on(four, session.host, 6 * MiB)
+        four.poll_for_state(STATE_BLOCKED)
+
+        # free host memory: only the host-blocked thread wakes
+        two.run(lambda: session.host.release(r_cpu))
+        assert fut4["done"].wait(5)
+        assert session.arbiter.get_state_of(three.thread_id) == STATE_BLOCKED
+        four.run(lambda: session.host.release(box4["r"]))
+
+        one.run(lambda: session.device.release(r_gpu))
+        assert fut3["done"].wait(5)
+        three.run(lambda: session.device.release(box3["r"]))
+    finally:
+        for a in actors:
+            a.done()
+
+
+def test_shuffle_thread_outranks_tasks(session):
+    # RmmSparkTest.testShuffleBlocking: a shuffle thread (task id -1) wakes
+    # before a task thread of any id.
+    shuffle = TaskActor(session, shuffle=True).start()
+    one = TaskActor(session, task_id=1).start()
+    two = TaskActor(session, task_id=2).start()
+    try:
+        session.arbiter.shuffle_thread_working_on_tasks([1], thread_id=shuffle.thread_id)
+        r1 = one.run(lambda: session.device.acquire(5 * MiB))
+
+        fut_s, box_s = alloc_on(shuffle, session.device, 6 * MiB)
+        shuffle.poll_for_state(STATE_BLOCKED)
+        fut2, box2 = alloc_on(two, session.device, 6 * MiB)
+        two.poll_for_state(STATE_BLOCKED)
+
+        one.run(lambda: session.device.release(r1))
+        # shuffle wins the wakeup even though task 2 blocked too
+        assert fut_s["done"].wait(5)
+        shuffle.run(lambda: session.device.release(box_s["r"]))
+        assert fut2["done"].wait(5)
+        two.run(lambda: session.device.release(box2["r"]))
+    finally:
+        session.arbiter.pool_thread_finished_for_tasks([1], thread_id=shuffle.thread_id)
+        one.done()
+        two.done()
+        shuffle._q.put(None)
+
+
+def test_lower_task_id_wakes_first(session):
+    # older task (lower id) = higher priority on wakeup
+    holder = TaskActor(session, task_id=1).start()
+    young = TaskActor(session, task_id=9).start()
+    old = TaskActor(session, task_id=2).start()
+    try:
+        r = holder.run(lambda: session.device.acquire(9 * MiB))
+        fut_y, box_y = alloc_on(young, session.device, 8 * MiB)
+        young.poll_for_state(STATE_BLOCKED)
+        fut_o, box_o = alloc_on(old, session.device, 8 * MiB)
+        old.poll_for_state(STATE_BLOCKED)
+
+        holder.run(lambda: session.device.release(r))
+        assert fut_o["done"].wait(5), "older task should wake first"
+        # the young task may get a transient wake (alloc-success wakes the
+        # next blocked thread to let it retry), but must re-block: the old
+        # task still holds the memory
+        assert not fut_y["done"].is_set()
+        young.poll_for_state(STATE_BLOCKED)
+        old.run(lambda: session.device.release(box_o["r"]))
+        assert fut_y["done"].wait(5)
+        young.run(lambda: session.device.release(box_y["r"]))
+    finally:
+        holder.done()
+        young.done()
+        old.done()
+
+
+def test_insert_oom_gpu(session):
+    # RmmSparkTest.testInsertOOMsGpu: injected retry-oom fires on the next
+    # alloc, then clears.
+    one = TaskActor(session, task_id=1).start()
+    try:
+        tid = one.thread_id
+        session.arbiter.force_retry_oom(tid, 1, OomInjectionType.GPU, 0)
+        with pytest.raises(RetryOOM):
+            one.run(lambda: session.device.acquire(1 * MiB))
+        # next alloc is clean
+        r = one.run(lambda: session.device.acquire(1 * MiB))
+        one.run(lambda: session.device.release(r))
+        assert session.arbiter.get_and_reset_num_retry_throw(1) == 1
+        assert session.arbiter.get_and_reset_num_retry_throw(1) == 0
+    finally:
+        one.done()
+
+
+def test_insert_oom_cpu_filter(session):
+    # CPU-filtered injection must not fire on device allocations
+    one = TaskActor(session, task_id=1).start()
+    try:
+        tid = one.thread_id
+        session.arbiter.force_retry_oom(tid, 1, OomInjectionType.CPU, 0)
+        r = one.run(lambda: session.device.acquire(1 * MiB))  # unaffected
+        one.run(lambda: session.device.release(r))
+        with pytest.raises(CpuRetryOOM):
+            one.run(lambda: session.host.acquire(1 * MiB))
+    finally:
+        one.done()
+
+
+def test_insert_multiple_ooms_with_skip(session):
+    one = TaskActor(session, task_id=1).start()
+    try:
+        tid = one.thread_id
+        # skip 1 alloc, then throw 2
+        session.arbiter.force_retry_oom(tid, 2, OomInjectionType.GPU, 1)
+        r = one.run(lambda: session.device.acquire(1 * MiB))
+        one.run(lambda: session.device.release(r))
+        for _ in range(2):
+            with pytest.raises(RetryOOM):
+                one.run(lambda: session.device.acquire(1 * MiB))
+        r = one.run(lambda: session.device.acquire(1 * MiB))
+        one.run(lambda: session.device.release(r))
+    finally:
+        one.done()
+
+
+def test_insert_split_and_retry_oom(session):
+    one = TaskActor(session, task_id=1).start()
+    try:
+        session.arbiter.force_split_and_retry_oom(one.thread_id, 1,
+                                                  OomInjectionType.GPU, 0)
+        with pytest.raises(SplitAndRetryOOM):
+            one.run(lambda: session.device.acquire(1 * MiB))
+        assert session.arbiter.get_and_reset_num_split_retry_throw(1) == 1
+    finally:
+        one.done()
+
+
+def test_injected_framework_exception(session):
+    one = TaskActor(session, task_id=1).start()
+    try:
+        session.arbiter.force_framework_exception(one.thread_id, 2)
+        for _ in range(2):
+            with pytest.raises(InjectedException):
+                one.run(lambda: session.device.acquire(1 * MiB))
+        r = one.run(lambda: session.device.acquire(1 * MiB))
+        one.run(lambda: session.device.release(r))
+    finally:
+        one.done()
+
+
+def test_basic_bufn(session):
+    # RmmSparkTest.testBasicBUFN:952 — task 3 (higher id = lower priority)
+    # becomes BUFN ahead of task 2, and only leaves BUFN when a *task
+    # finishes*, not merely when memory frees.
+    three = TaskActor(session, task_id=3).start()
+    two = TaskActor(session, task_id=2).start()
+    try:
+        r3a = three.run(lambda: session.device.acquire(5 * MiB))
+        r2a = two.run(lambda: session.device.acquire(3 * MiB))
+
+        fut2b, box2b = alloc_on(two, session.device, 3 * MiB)
+        two.poll_for_state(STATE_BLOCKED)
+
+        # task 3 asks too: now everyone is blocked → the lowest-priority
+        # thread (task 3) is rolled back with RetryOOM
+        fut3b, box3b = alloc_on(three, session.device, 4 * MiB)
+        three.poll_for_state(STATE_BUFN_WAIT, timeout=5)
+        assert fut3b["done"].wait(5)
+        assert isinstance(fut3b.get("error"), RetryOOM)
+
+        # task 3 rolls back (frees its 5 MiB) → task 2's blocked alloc wakes
+        three.run(lambda: session.device.release(r3a))
+        assert fut2b["done"].wait(5)
+        assert "error" not in fut2b
+
+        # task 3 now waits for further notice: parks in BUFN
+        fut_block = three.submit(lambda: session.arbiter.block_thread_until_ready())
+        three.poll_for_state(STATE_BUFN)
+
+        # task 2 freeing everything does NOT wake task 3 (only progress in
+        # the form of a finished task does)
+        two.run(lambda: session.device.release(box2b["r"]))
+        two.run(lambda: session.device.release(r2a))
+        assert session.arbiter.get_state_of(two.thread_id) == STATE_RUNNING
+        assert session.arbiter.get_state_of(three.thread_id) == STATE_BUFN
+
+        # task 2 finishes → task 3 wakes
+        two.done()
+        assert fut_block["done"].wait(5)
+        assert "error" not in fut_block
+        three.poll_for_state(STATE_RUNNING)
+        assert session.arbiter.get_and_reset_num_retry_throw(3) == 1
+    finally:
+        three.done()
+
+
+def test_bufn_split_and_retry_single_thread(session):
+    # RmmSparkTest.testBUFNSplitAndRetrySingleThread:1079 — a task wedged
+    # alone first rolls back (RetryOOM), then its block-until-ready is
+    # answered with SplitAndRetryOOM, leaving it RUNNING; half-size works.
+    one = TaskActor(session, task_id=0).start()
+    try:
+        r1 = one.run(lambda: session.device.acquire(5 * MiB))
+
+        fut, box = alloc_on(one, session.device, 6 * MiB)
+        assert fut["done"].wait(5)
+        assert isinstance(fut.get("error"), RetryOOM)
+
+        with pytest.raises(SplitAndRetryOOM):
+            one.run(lambda: session.arbiter.block_thread_until_ready())
+        assert session.arbiter.get_state_of(one.thread_id) == STATE_RUNNING
+
+        # retry with half the data
+        r2 = one.run(lambda: session.device.acquire(3 * MiB))
+        one.run(lambda: session.device.release(r2))
+        one.run(lambda: session.device.release(r1))
+        assert session.arbiter.get_and_reset_num_retry_throw(0) == 1
+        assert session.arbiter.get_and_reset_num_split_retry_throw(0) == 1
+    finally:
+        one.done()
+
+
+def test_with_retry_helper(session):
+    # the full protocol through the with_retry convenience wrapper
+    one = TaskActor(session, task_id=1).start()
+    try:
+        session.arbiter.force_retry_oom(one.thread_id, 1, OomInjectionType.GPU, 0)
+        calls = []
+
+        def attempt(nbytes):
+            calls.append(nbytes)
+            r = session.device.acquire(nbytes)
+            session.device.release(r)
+            return nbytes
+
+        out = one.run(lambda: with_retry(
+            session.arbiter, attempt, 4 * MiB,
+            split=lambda n: [n // 2, n // 2]))
+        assert out == [4 * MiB]
+        assert len(calls) == 2  # one injected failure + one success
+    finally:
+        one.done()
+
+
+def test_with_retry_split(session):
+    one = TaskActor(session, task_id=1).start()
+    try:
+        session.arbiter.force_split_and_retry_oom(one.thread_id, 1,
+                                                  OomInjectionType.GPU, 0)
+
+        def attempt(nbytes):
+            r = session.device.acquire(nbytes)
+            session.device.release(r)
+            return nbytes
+
+        out = one.run(lambda: with_retry(
+            session.arbiter, attempt, 8 * MiB,
+            split=lambda n: [n // 2, n // 2]))
+        assert out == [4 * MiB, 4 * MiB]
+    finally:
+        one.done()
+
+
+def test_with_retry_split_via_block_escalation(session):
+    # A task wedged alone: attempt() raises a real (watchdog-driven)
+    # RetryOOM, and the follow-up block_thread_until_ready answers with
+    # SplitAndRetryOOM — with_retry must still split.
+    one = TaskActor(session, task_id=0).start()
+    try:
+        held = one.run(lambda: session.device.acquire(5 * MiB))
+
+        def attempt(nbytes):
+            r = session.device.acquire(nbytes)
+            session.device.release(r)
+            return nbytes
+
+        out = one.run(lambda: with_retry(
+            session.arbiter, attempt, 6 * MiB,
+            split=lambda n: [n // 2, n // 2]), timeout=20)
+        assert out == [3 * MiB, 3 * MiB]
+        one.run(lambda: session.device.release(held))
+        assert session.arbiter.get_and_reset_num_retry_throw(0) >= 1
+        assert session.arbiter.get_and_reset_num_split_retry_throw(0) == 1
+    finally:
+        one.done()
+
+
+def test_retry_limit_hard_oom(session):
+    # livelock watchdog (SparkResourceAdaptorJni.cpp:984-995): a task whose
+    # retry/split loop never makes progress gets a hard OOM after the limit.
+    # (Injected OOMs deliberately bypass the watchdog, like the reference.)
+    session.arbiter.set_retry_limit(5)
+    one = TaskActor(session, task_id=1).start()
+    try:
+        one.run(lambda: session.device.acquire(9 * MiB))
+
+        def spin():
+            from spark_rapids_tpu.runtime import ArbiterOOM
+            while True:
+                try:
+                    r = session.device.acquire(2 * MiB)
+                    session.device.release(r)
+                    return
+                except HardOOM:
+                    raise
+                except ArbiterOOM:
+                    continue  # never frees anything: no progress is possible
+
+        with pytest.raises(HardOOM):
+            one.run(spin, timeout=30)
+    finally:
+        one.done()
+
+
+def test_metrics_block_time(session):
+    one = TaskActor(session, task_id=1).start()
+    two = TaskActor(session, task_id=2).start()
+    try:
+        r1 = one.run(lambda: session.device.acquire(8 * MiB))
+        fut2, box2 = alloc_on(two, session.device, 8 * MiB)
+        two.poll_for_state(STATE_BLOCKED)
+        time.sleep(0.05)
+        one.run(lambda: session.device.release(r1))
+        assert fut2["done"].wait(5)
+        two.run(lambda: session.device.release(box2["r"]))
+        blocked_ns = session.arbiter.get_and_reset_block_time_ns(2)
+        assert blocked_ns >= 30_000_000  # slept 50 ms while blocked
+    finally:
+        one.done()
+        two.done()
+
+
+def test_task_done_wakes_blocked(session):
+    one = TaskActor(session, task_id=1).start()
+    two = TaskActor(session, task_id=2).start()
+    try:
+        r1 = one.run(lambda: session.device.acquire(8 * MiB))
+        fut2, box2 = alloc_on(two, session.device, 8 * MiB)
+        two.poll_for_state(STATE_BLOCKED)
+        # finishing task 1 wakes task 2 (wake_up_threads_after_task_finishes)
+        one.run(lambda: session.device.release(r1))
+        one.done()
+        assert fut2["done"].wait(5)
+        two.run(lambda: session.device.release(box2["r"]))
+    finally:
+        two.done()
+
+
+def test_dedicated_thread_reassociation(session):
+    # reference testReentrantAssociateThread: re-registering the same
+    # thread/task is a no-op; a new task rebinds after removal
+    one = TaskActor(session, task_id=1).start()
+    try:
+        one.run(lambda: session.arbiter.current_thread_is_dedicated_to_task(1))
+        one.run(lambda: session.arbiter.current_thread_is_dedicated_to_task(1))
+        # rebinding to a different task goes through the FIXUP path
+        one.run(lambda: session.arbiter.current_thread_is_dedicated_to_task(7))
+        session.arbiter.task_done(7)
+    finally:
+        one.done()
+
+
+def test_transition_log(tmp_path):
+    log = tmp_path / "state.csv"
+    with DeviceSession(10 * MiB, log_loc=str(log)) as s:
+        a = TaskActor(s, task_id=1).start()
+        r = a.run(lambda: s.device.acquire(1 * MiB))
+        a.run(lambda: s.device.release(r))
+        a.done()
+    lines = log.read_text().strip().splitlines()
+    assert lines[0] == "time,op,current thread,op thread,op task,from state,to state,notes"
+    assert any("TRANSITION" in ln and "THREAD_ALLOC" in ln for ln in lines)
+    assert any("DEALLOC" in ln for ln in lines)
+
+
+def test_non_blocking_alloc_failure_does_not_block(session):
+    one = TaskActor(session, task_id=1).start()
+    try:
+        r1 = one.run(lambda: session.device.acquire(8 * MiB))
+
+        def try_nonblocking():
+            assert session.device.try_acquire(8 * MiB) is None
+
+        one.run(try_nonblocking)
+        assert session.arbiter.get_state_of(one.thread_id) == STATE_RUNNING
+        one.run(lambda: session.device.release(r1))
+    finally:
+        one.done()
