@@ -15,7 +15,6 @@ _LOCK = threading.Lock()
 
 _SOURCES = {
     "resource_adaptor": ["resource_adaptor.cpp"],
-    "parquet_footer": ["parquet_footer.cpp"],
 }
 
 

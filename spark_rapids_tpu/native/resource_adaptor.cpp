@@ -54,6 +54,7 @@ enum Status : int {
   SRA_THREAD_REMOVED       = 6,  // thread was removed while blocked
   SRA_RETRY_LIMIT_EXCEEDED = 7,  // livelock watchdog tripped: hard OOM
   SRA_INVALID              = 8,  // bad argument / internal error (see last_error)
+  SRA_BUSY                 = 9,  // shutdown timed out with threads still live
 };
 
 enum class ThreadState : int {
@@ -363,7 +364,9 @@ class ResourceArbiter {
     task_metrics_.erase(task_id);
   }
 
-  void all_done(int64_t self)
+  // Returns true when every thread has exited; callers must not destroy the
+  // arbiter after a false return (a straggler may still be blocked on mu_).
+  bool all_done(int64_t self)
   {
     std::unique_lock<std::mutex> lock(mu_);
     std::vector<int64_t> tids;
@@ -373,8 +376,8 @@ class ResourceArbiter {
       remove_thread_association(tid, -1, self, lock);
     shutting_down_ = true;
     // bounded wait for blocked threads to notice REMOVE_THROW and exit
-    woken_cv_.wait_for(lock, std::chrono::milliseconds(1000),
-                       [this] { return threads_.empty(); });
+    return woken_cv_.wait_for(lock, std::chrono::milliseconds(1000),
+                              [this] { return threads_.empty(); });
   }
 
   // ---- pool-wait bracketing and external-block hints ----------------------
@@ -867,7 +870,10 @@ class ResourceArbiter {
       if (it != scan.pool_threads_per_task.end() && it->second <= bufn_count)
         scan.bufn_tasks.insert(task_id);
     }
-    if (scan.bufn_tasks.size() != scan.all_tasks.size()) return;
+    // split only when every known task is at BUFN — membership, not size:
+    // bufn_tasks may contain pool-only task ids that all_tasks lacks
+    for (auto task_id : scan.all_tasks)
+      if (scan.bufn_tasks.find(task_id) == scan.bufn_tasks.end()) return;
 
     ThreadRec* best = nullptr;
     for (auto& [tid, rec] : threads_) {
@@ -1069,9 +1075,15 @@ int sra_task_done(void* h, int64_t task_id, int64_t self)
   return guarded([&] { static_cast<ResourceArbiter*>(h)->task_done(task_id, self); });
 }
 
+// Returns SRA_OK when quiesced; SRA_BUSY when some thread never exited within
+// the bounded wait, in which case the handle must be leaked, not destroyed.
 int sra_all_done(void* h, int64_t self)
 {
-  return guarded([&] { static_cast<ResourceArbiter*>(h)->all_done(self); });
+  int rc = SRA_OK;
+  int g  = guarded([&] {
+    if (!static_cast<ResourceArbiter*>(h)->all_done(self)) rc = SRA_BUSY;
+  });
+  return g != SRA_OK ? g : rc;
 }
 
 int sra_set_pool_blocked(void* h, int64_t tid, int blocked)

@@ -13,6 +13,9 @@ from .datetime_rebase import (rebase_gregorian_to_julian,
 from .bloom_filter import (BloomFilter, bloom_filter_create, bloom_filter_put,
                            bloom_filter_merge, bloom_filter_probe,
                            bloom_filter_serialize, bloom_filter_deserialize)
+from .timezones import (TimeZoneDB, from_timestamp_to_utc_timestamp,
+                        from_utc_timestamp_to_timestamp,
+                        is_supported_time_zone)
 
 __all__ = [
     "murmur_hash3_32", "xxhash64", "DEFAULT_XXHASH64_SEED",
@@ -25,4 +28,6 @@ __all__ = [
     "BloomFilter", "bloom_filter_create", "bloom_filter_put",
     "bloom_filter_merge", "bloom_filter_probe", "bloom_filter_serialize",
     "bloom_filter_deserialize",
+    "TimeZoneDB", "from_timestamp_to_utc_timestamp",
+    "from_utc_timestamp_to_timestamp", "is_supported_time_zone",
 ]

@@ -27,6 +27,7 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
+import weakref
 from typing import Iterable, Optional
 
 from ..native.build import build
@@ -76,6 +77,10 @@ _STATUS_TO_EXC = {
     7: HardOOM,
     8: ValueError,
 }
+
+# shutdown timed out with threads still parked on native state (not an
+# exception: close() reacts by leaking the handle instead of destroying it)
+SRA_BUSY = 9
 
 # Thread states, numerically identical to RmmSparkThreadState.java:23-34.
 STATE_UNKNOWN = -1
@@ -158,6 +163,18 @@ def current_thread_id() -> int:
     return threading.get_native_id()
 
 
+def _watchdog_loop(arbiter_ref, stop: threading.Event, period_s: float):
+    """Deadlock-watchdog body (daemon thread, 100 ms cadence — the Java
+    watchdog in SparkResourceAdaptor.java:59-69)."""
+    me = current_thread_id()
+    while not stop.wait(period_s):
+        arbiter = arbiter_ref()
+        if arbiter is None or arbiter._closed:
+            return
+        arbiter._lib.sra_check_and_break_deadlocks(arbiter._h, me)
+        del arbiter  # drop the strong ref before sleeping
+
+
 class ResourceArbiter:
     """One arbiter per device (per process). Owns the native state machine and
     the deadlock watchdog daemon (100 ms cadence, like
@@ -175,8 +192,12 @@ class ResourceArbiter:
         self._watchdog_stop = threading.Event()
         self._watchdog = None
         if watchdog:
+            # weakref target: a bound-method target would root the arbiter
+            # and keep __del__ from ever firing
             self._watchdog = threading.Thread(
-                target=self._watchdog_loop, name="tpu-arbiter-watchdog", daemon=True)
+                target=_watchdog_loop,
+                args=(weakref.ref(self), self._watchdog_stop, self.WATCHDOG_PERIOD_S),
+                name="tpu-arbiter-watchdog", daemon=True)
             self._watchdog.start()
 
     # -- plumbing -------------------------------------------------------------
@@ -186,23 +207,26 @@ class ResourceArbiter:
         msg = self._lib.sra_last_error().decode()
         raise _STATUS_TO_EXC.get(code, RuntimeError)(msg)
 
-    def _watchdog_loop(self):
-        me = current_thread_id()
-        while not self._watchdog_stop.wait(self.WATCHDOG_PERIOD_S):
-            if self._closed:
-                return
-            self._lib.sra_check_and_break_deadlocks(self._h, me)
-
     def close(self):
         with self._close_lock:
             if self._closed:
                 return
             self._watchdog_stop.set()
+            watchdog_live = False
             if self._watchdog is not None and self._watchdog is not threading.current_thread():
                 self._watchdog.join(timeout=5)  # never destroy under its feet
-            self._lib.sra_all_done(self._h, current_thread_id())
+                watchdog_live = self._watchdog.is_alive()
+            rc = self._lib.sra_all_done(self._h, current_thread_id())
             self._closed = True
-            self._lib.sra_destroy(self._h)
+            # A straggler (SRA_BUSY: a registered thread never observed
+            # REMOVE_THROW within the bounded wait; or a watchdog stalled past
+            # the join timeout) may still be parked on native state —
+            # destroying now would free memory under its feet, so leak the
+            # handle instead. Same shutdown hazard the reference bounds with
+            # its 1 s wait (SparkResourceAdaptorJni.cpp all_done :659-690);
+            # we choose leak over use-after-free.
+            if rc != SRA_BUSY and not watchdog_live:
+                self._lib.sra_destroy(self._h)
 
     def __enter__(self):
         return self

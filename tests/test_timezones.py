@@ -1,0 +1,149 @@
+"""Timezone conversion tests.
+
+Oracle: Python's zoneinfo/datetime (fold=0 disambiguation), the same oracle
+role java.time plays in the reference's TimeZoneTest (SURVEY.md §4 tier 2).
+Both the oracle and the implementation ultimately derive from the system
+tzdata, so parity is exact for supported (no-recurring-DST) zones.
+"""
+import datetime
+from datetime import timezone
+from zoneinfo import ZoneInfo
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import dtypes
+from spark_rapids_tpu.columnar import Column
+from spark_rapids_tpu.ops.timezones import (
+    TimeZoneDB, from_timestamp_to_utc_timestamp,
+    from_utc_timestamp_to_timestamp, is_supported_time_zone,
+    normalize_zone_id)
+
+UTC = timezone.utc
+
+
+def us_col(micros):
+    return Column.from_numpy(np.array(micros, np.int64), dtypes.TIMESTAMP_US)
+
+
+def wall_to_utc_oracle(micros, zone):
+    """Interpret micros as wall clock in `zone` -> UTC micros (fold=0)."""
+    tz = ZoneInfo(zone)
+    out = []
+    for us in micros:
+        sec, frac = divmod(us, 1_000_000)
+        naive = datetime.datetime(1970, 1, 1) + datetime.timedelta(seconds=sec)
+        aware = naive.replace(tzinfo=tz, fold=0)
+        out.append(int(aware.timestamp()) * 1_000_000 + frac)
+    return out
+
+
+def utc_to_wall_oracle(micros, zone):
+    tz = ZoneInfo(zone)
+    out = []
+    for us in micros:
+        sec, frac = divmod(us, 1_000_000)
+        dt = datetime.datetime.fromtimestamp(sec, UTC).astimezone(tz)
+        wall = dt.replace(tzinfo=UTC)
+        out.append(int(wall.timestamp()) * 1_000_000 + frac)
+    return out
+
+
+SUPPORTED_ZONES = ["Asia/Shanghai", "America/Phoenix", "Pacific/Kiritimati",
+                   "Asia/Kolkata", "Asia/Tokyo"]
+
+
+@pytest.mark.parametrize("zone", SUPPORTED_ZONES)
+def test_utc_to_zone_matches_zoneinfo(zone):
+    if not is_supported_time_zone(zone):
+        pytest.skip(f"{zone} has recurring DST rules in this tzdata")
+    micros = [0, 1_700_000_000_000_000, -123_456_000_000,
+              631_152_000_000_000, 86_399_999_999]
+    got = from_utc_timestamp_to_timestamp(us_col(micros), zone).to_pylist()
+    assert got == utc_to_wall_oracle(micros, zone)
+
+
+@pytest.mark.parametrize("zone", SUPPORTED_ZONES)
+def test_zone_to_utc_matches_zoneinfo(zone):
+    if not is_supported_time_zone(zone):
+        pytest.skip(f"{zone} has recurring DST rules in this tzdata")
+    micros = [0, 1_700_000_000_000_000, 631_152_000_000_000,
+              946_684_800_000_000]
+    got = from_timestamp_to_utc_timestamp(us_col(micros), zone).to_pylist()
+    assert got == wall_to_utc_oracle(micros, zone)
+
+
+def test_gap_day_skip_kiritimati():
+    # Kiritimati skipped 1994-12-31 entirely (UTC-10:40 -> UTC+14).
+    # A wall-clock timestamp inside the skipped day resolves with the
+    # pre-transition offset (fold=0 rule), matching Spark.
+    zone = "Pacific/Kiritimati"
+    wall = int((datetime.datetime(1994, 12, 31, 12, 0) -
+                datetime.datetime(1970, 1, 1)).total_seconds()) * 1_000_000
+    got = from_timestamp_to_utc_timestamp(us_col([wall]), zone).to_pylist()
+    assert got == wall_to_utc_oracle([wall], zone)
+
+
+def test_fixed_offset_zones():
+    micros = [0, 1_000_000, -1, 1_700_000_000_123_456]
+    for zid, off_s in [("+08:00", 8 * 3600), ("-09:30", -(9 * 3600 + 30 * 60)),
+                      ("UTC", 0), ("GMT+05:30", 5 * 3600 + 30 * 60),
+                      ("UTC-3:00", -3 * 3600)]:
+        got = from_utc_timestamp_to_timestamp(us_col(micros), zid).to_pylist()
+        assert got == [m + off_s * 1_000_000 for m in micros], zid
+        got = from_timestamp_to_utc_timestamp(us_col(micros), zid).to_pylist()
+        assert got == [m - off_s * 1_000_000 for m in micros], zid
+
+
+def test_short_ids():
+    # EST/MST/HST are fixed offsets in java.time SHORT_IDS
+    micros = [1_600_000_000_000_000]
+    got = from_utc_timestamp_to_timestamp(us_col(micros), "EST").to_pylist()
+    assert got == [micros[0] - 5 * 3600 * 1_000_000]
+    got = from_utc_timestamp_to_timestamp(us_col(micros), "HST").to_pylist()
+    assert got == [micros[0] - 10 * 3600 * 1_000_000]
+
+
+def test_spark_legacy_offset_formats():
+    # (+|-)h:mm and (+|-)hh:m fixups (GpuTimeZoneDB.getZoneId)
+    assert normalize_zone_id("+8:00") == "+08:00"
+    assert normalize_zone_id("-09:3") == "-09:03"
+    micros = [0]
+    got = from_utc_timestamp_to_timestamp(us_col(micros), "+8:00").to_pylist()
+    assert got == [8 * 3600 * 1_000_000]
+
+
+def test_unsupported_zone_raises():
+    # zones with recurring DST rules are rejected like the reference
+    # (GpuTimeZoneDB.java:207-210)
+    if is_supported_time_zone("America/Los_Angeles"):
+        pytest.skip("tzdata unexpectedly lists LA as rule-free")
+    with pytest.raises(ValueError):
+        from_utc_timestamp_to_timestamp(us_col([0]), "America/Los_Angeles")
+    assert not is_supported_time_zone("not/a_zone")
+
+
+def test_validity_preserved():
+    col = Column.from_pylist([0, None, 1_000_000], dtypes.TIMESTAMP_US)
+    got = from_utc_timestamp_to_timestamp(col, "+01:00").to_pylist()
+    assert got == [3_600_000_000, None, 3_601_000_000]
+
+
+def test_millis_and_seconds_units():
+    ms = Column.from_numpy(np.array([1_700_000_000_000], np.int64),
+                           dtypes.TIMESTAMP_MS)
+    got = from_utc_timestamp_to_timestamp(ms, "Asia/Tokyo").to_pylist()
+    assert got == [1_700_000_000_000 + 9 * 3600 * 1000]
+    s = Column.from_numpy(np.array([1_700_000_000], np.int64),
+                          dtypes.TIMESTAMP_S)
+    got = from_utc_timestamp_to_timestamp(s, "Asia/Tokyo").to_pylist()
+    assert got == [1_700_000_000 + 9 * 3600]
+
+
+def test_singleton_cache_and_shutdown():
+    db1 = TimeZoneDB.cache_database()
+    db2 = TimeZoneDB.cache_database()
+    assert db1 is db2
+    TimeZoneDB.shutdown()
+    db3 = TimeZoneDB.cache_database()
+    assert db3 is not db1
