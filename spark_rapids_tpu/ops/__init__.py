@@ -16,6 +16,11 @@ from .bloom_filter import (BloomFilter, bloom_filter_create, bloom_filter_put,
 from .timezones import (TimeZoneDB, from_timestamp_to_utc_timestamp,
                         from_utc_timestamp_to_timestamp,
                         is_supported_time_zone)
+from .cast_float_to_string import float_to_string
+from .format_float import format_float
+from .row_conversion import (convert_to_rows,
+                             convert_to_rows_fixed_width_optimized,
+                             convert_from_rows, row_layout)
 
 __all__ = [
     "murmur_hash3_32", "xxhash64", "DEFAULT_XXHASH64_SEED",
@@ -30,4 +35,7 @@ __all__ = [
     "bloom_filter_deserialize",
     "TimeZoneDB", "from_timestamp_to_utc_timestamp",
     "from_utc_timestamp_to_timestamp", "is_supported_time_zone",
+    "float_to_string", "format_float",
+    "convert_to_rows", "convert_to_rows_fixed_width_optimized",
+    "convert_from_rows", "row_layout",
 ]

@@ -1,0 +1,189 @@
+"""float->string and format_number tests.
+
+Oracle: numpy's shortest round-trip formatting (format_float_scientific with
+unique=True — the same shortest-digits contract as Ryu) re-assembled with
+Java's Float/Double.toString layout rules, and Python decimal half-even
+quantization for format_number — the oracle roles the JDK plays for the
+reference's gtests (golden vectors from tests/cast_float_to_string.cpp and
+tests/format_float.cpp are embedded below).
+"""
+import decimal
+import math
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import dtypes
+from spark_rapids_tpu.columnar import Column
+from spark_rapids_tpu.ops.cast_float_to_string import float_to_string
+from spark_rapids_tpu.ops.format_float import format_float
+
+
+def shortest_digits(x, is32):
+    f = np.float32 if is32 else np.float64
+    rs = np.format_float_scientific(f(x), unique=True, trim="0")
+    neg = rs.startswith("-")
+    mant, _, e = rs.lstrip("-").partition("e")
+    return neg, (mant.replace(".", "").rstrip("0") or "0"), int(e)
+
+
+def java_to_string(x, is32):
+    if math.isnan(x):
+        return "NaN"
+    if math.isinf(x):
+        return "Infinity" if x > 0 else "-Infinity"
+    if x == 0:
+        return "-0.0" if math.copysign(1, x) < 0 else "0.0"
+    neg, digs, exp = shortest_digits(x, is32)
+    s = "-" if neg else ""
+    if -3 <= exp <= 6:
+        if exp >= 0:
+            ip = exp + 1
+            return s + digs[:ip].ljust(ip, "0") + "." + (digs[ip:] or "0")
+        return s + "0." + "0" * (-exp - 1) + digs
+    return s + digs[0] + "." + (digs[1:] or "0") + "E" + str(exp)
+
+
+def spark_format_number(x, d, is32):
+    if math.isnan(x):
+        return "�"
+    if math.isinf(x):
+        return ("-" if x < 0 else "") + "∞"
+    if x == 0:
+        s = "-" if math.copysign(1, x) < 0 else ""
+        return s + ("0." + "0" * d if d else "0")
+    neg, digs, exp = shortest_digits(x, is32)
+    ctx = decimal.Context(prec=500)
+    val = ctx.scaleb(decimal.Decimal(digs), exp - len(digs) + 1)
+    q = val.quantize(decimal.Decimal(1).scaleb(-d),
+                     rounding=decimal.ROUND_HALF_EVEN, context=ctx)
+    body = f"{q:,f}"
+    return ("-" if neg else "") + body
+
+
+GOLDEN_F32 = [
+    (100.0, "100.0"), (654321.25, "654321.25"), (-12761.125, "-12761.125"),
+    (0.0, "0.0"), (5.0, "5.0"), (-4.0, "-4.0"), (float("nan"), "NaN"),
+    (123456789012.34, "1.2345679E11"), (-0.0, "-0.0"),
+]
+
+GOLDEN_F64 = [
+    (100.0, "100.0"), (654321.25, "654321.25"), (-12761.125, "-12761.125"),
+    (1.123456789123456789, "1.1234567891234568"),
+    (1.23456789123456789e-19, "1.234567891234568E-19"),
+    (0.0, "0.0"), (5.0, "5.0"), (-4.0, "-4.0"), (float("nan"), "NaN"),
+    (839542223232.794248339, "8.395422232327942E11"), (-0.0, "-0.0"),
+    (float("inf"), "Infinity"), (float("-inf"), "-Infinity"),
+]
+
+
+def test_golden_float32():
+    vals = np.array([v for v, _ in GOLDEN_F32], np.float32)
+    got = float_to_string(Column.from_numpy(vals, dtypes.FLOAT32)).to_pylist()
+    assert got == [w for _, w in GOLDEN_F32]
+
+
+def test_golden_float64():
+    vals = np.array([v for v, _ in GOLDEN_F64], np.float64)
+    got = float_to_string(Column.from_numpy(vals, dtypes.FLOAT64)).to_pylist()
+    assert got == [w for _, w in GOLDEN_F64]
+
+
+def test_random_bits_float64_vs_oracle():
+    rng = np.random.default_rng(7)
+    bits = rng.integers(0, 2**64, size=5000, dtype=np.uint64)
+    shift = rng.integers(0, 53, size=5000, dtype=np.uint64)
+    vals = ((bits >> shift) << shift).view(np.float64)
+    got = float_to_string(Column.from_numpy(vals, dtypes.FLOAT64)).to_pylist()
+    want = [java_to_string(float(v), False) for v in vals]
+    assert got == want
+
+
+def test_random_bits_float32_vs_oracle():
+    rng = np.random.default_rng(8)
+    bits = rng.integers(0, 2**32, size=5000, dtype=np.uint32)
+    shift = rng.integers(0, 24, size=5000, dtype=np.uint32)
+    vals = ((bits >> shift) << shift).view(np.float32)
+    got = float_to_string(Column.from_numpy(vals, dtypes.FLOAT32)).to_pylist()
+    want = [java_to_string(float(v), True) for v in vals]
+    assert got == want
+
+
+def test_boundaries_and_subnormals():
+    vals = np.array([5e-324, -5e-324, 2.2250738585072014e-308,
+                     1.7976931348623157e308, 1e-3, 1e7, 9999999.999999998,
+                     0.001, 0.0009999999999999998], np.float64)
+    got = float_to_string(Column.from_numpy(vals, dtypes.FLOAT64)).to_pylist()
+    assert got == [java_to_string(float(v), False) for v in vals]
+
+
+def test_nulls_preserved():
+    col = Column.from_pylist([1.5, None, -2.5], dtypes.FLOAT64)
+    assert float_to_string(col).to_pylist() == ["1.5", None, "-2.5"]
+
+
+# ---------------------------------------------------------------------------
+# format_number
+# ---------------------------------------------------------------------------
+
+FORMAT_GOLDEN_F32 = [
+    (100.0, "100.00000"), (654321.25, "654,321.25000"),
+    (-12761.125, "-12,761.12500"), (0.0, "0.00000"), (5.0, "5.00000"),
+    (-4.0, "-4.00000"), (float("nan"), "�"),
+    (123456789012.34, "123,456,790,000.00000"), (-0.0, "-0.00000"),
+]
+
+
+def test_format_golden_float32():
+    vals = np.array([v for v, _ in FORMAT_GOLDEN_F32], np.float32)
+    got = format_float(Column.from_numpy(vals, dtypes.FLOAT32), 5).to_pylist()
+    assert got == [w for _, w in FORMAT_GOLDEN_F32]
+
+
+def test_format_golden_float64():
+    vals = np.array([100.0, 654321.25, -12761.125, 1.123456789123456789,
+                     1.23456789123456789e-19, 0.0, 5.0, -4.0,
+                     839542223232.794248339, 3232.794248339, 11234000000.0,
+                     -0.0], np.float64)
+    want = ["100.00000", "654,321.25000", "-12,761.12500", "1.12346",
+            "0.00000", "0.00000", "5.00000", "-4.00000",
+            "839,542,223,232.79420", "3,232.79425", "11,234,000,000.00000",
+            "-0.00000"]
+    got = format_float(Column.from_numpy(vals, dtypes.FLOAT64), 5).to_pylist()
+    assert got == want
+
+
+@pytest.mark.parametrize("d", [0, 1, 2, 6])
+def test_format_random_vs_decimal_oracle(d):
+    rng = np.random.default_rng(100 + d)
+    vals = np.concatenate([
+        rng.uniform(-1e6, 1e6, 300),
+        rng.uniform(-1, 1, 200),
+        rng.uniform(-1e12, 1e12, 100),
+        10.0 ** rng.integers(-8, 12, 100) * rng.choice([-1, 1], 100),
+    ])
+    got = format_float(Column.from_numpy(vals, dtypes.FLOAT64), d).to_pylist()
+    want = [spark_format_number(float(v), d, False) for v in vals]
+    assert got == want
+
+
+def test_format_half_even_and_carry():
+    vals = np.array([0.95, 0.05, 0.15, 0.25, 0.06, 0.005, 9.99, 99.995,
+                     0.999999, 1e-10], np.float64)
+    got = format_float(Column.from_numpy(vals, dtypes.FLOAT64), 2).to_pylist()
+    want = [spark_format_number(float(v), 2, False) for v in vals]
+    assert got == want
+
+
+def test_format_infinity_and_nulls():
+    col = Column.from_pylist([float("inf"), None, float("-inf")],
+                             dtypes.FLOAT64)
+    got = format_float(col, 2).to_pylist()
+    assert got == ["∞", None, "-∞"]
+
+
+def test_format_huge_exponent():
+    vals = np.array([1e300], np.float64)
+    [got] = format_float(Column.from_numpy(vals, dtypes.FLOAT64), 2).to_pylist()
+    assert got == spark_format_number(1e300, 2, False)
+    assert len(got) == 404
