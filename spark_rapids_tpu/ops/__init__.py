@@ -21,6 +21,10 @@ from .format_float import format_float
 from .row_conversion import (convert_to_rows,
                              convert_to_rows_fixed_width_optimized,
                              convert_from_rows, row_layout)
+from .parse_uri import (parse_uri_to_protocol, parse_uri_to_host,
+                        parse_uri_to_query, parse_uri_to_query_literal,
+                        parse_uri_to_query_column)
+from .histogram import create_histogram_if_valid, percentile_from_histogram
 
 __all__ = [
     "murmur_hash3_32", "xxhash64", "DEFAULT_XXHASH64_SEED",
@@ -38,4 +42,7 @@ __all__ = [
     "float_to_string", "format_float",
     "convert_to_rows", "convert_to_rows_fixed_width_optimized",
     "convert_from_rows", "row_layout",
+    "parse_uri_to_protocol", "parse_uri_to_host", "parse_uri_to_query",
+    "parse_uri_to_query_literal", "parse_uri_to_query_column",
+    "create_histogram_if_valid", "percentile_from_histogram",
 ]

@@ -1,0 +1,221 @@
+"""Host-side oracle reimplementing java.net.URI's parser (RFC 2396 +
+Java deviations), used by test_parse_uri.py the way the reference's
+ParseURITest uses java.net.URI itself. Scalar string code on purpose —
+structurally unrelated to the vectorized kernel it checks.
+
+Returns (scheme, host, raw_query); all None when the URI is invalid.
+"""
+import string
+import unicodedata
+
+ALPHA = set(string.ascii_letters)
+DIGIT = set(string.digits)
+ALNUM = ALPHA | DIGIT
+MARK = set("-_.!~*'()")
+UNRESERVED = ALNUM | MARK
+RESERVED = set(";/?:@&=+$,[]")
+URIC = UNRESERVED | RESERVED
+SCHEME_CH = ALNUM | set("+-.")
+USERINFO_CH = UNRESERVED | set(";:&=+$,")
+REG_CH = UNRESERVED | set("$,;:@&=+")
+PATH_CH = UNRESERVED | set(":@&=+$,;/")
+HEX = set(string.hexdigits)
+
+
+class Invalid(Exception):
+    pass
+
+
+def _char_never_legal(ch):
+    o = ord(ch)
+    if o <= 0x1F or (0x7F <= o <= 0x9F):      # ISO control
+        return True
+    if ch == " " or unicodedata.category(ch) in ("Zs", "Zl", "Zp"):
+        return True
+    return False
+
+
+def _check(s, allowed, escapes=True, other=True):
+    i = 0
+    while i < len(s):
+        ch = s[i]
+        if _char_never_legal(ch):
+            raise Invalid(ch)
+        if ch in allowed:
+            i += 1
+        elif escapes and ch == "%":
+            if i + 3 <= len(s) and s[i + 1] in HEX and s[i + 2] in HEX:
+                i += 3
+            else:
+                raise Invalid("%")
+        elif other and ord(ch) > 127:
+            i += 1
+        else:
+            raise Invalid(ch)
+
+
+def _parse_ipv4(s):
+    parts = s.split(".")
+    if len(parts) != 4:
+        return False
+    for p in parts:
+        if not (1 <= len(p) <= 3 and all(c in DIGIT for c in p)
+                and int(p) <= 255):
+            return False
+    return True
+
+
+def _parse_hostname(s):
+    if not s:
+        raise Invalid("empty host")
+    body = s[:-1] if s.endswith(".") else s
+    if not body:
+        raise Invalid("lone dot")
+    labels = body.split(".")
+    for lab in labels:
+        if not lab:
+            raise Invalid("empty label")
+        if not all(c in ALNUM or c == "-" for c in lab):
+            raise Invalid("hostname char")
+        if lab[0] == "-" or lab[-1] == "-":
+            raise Invalid("label dash")
+    if labels[-1][0] not in ALPHA:
+        raise Invalid("last label must start with alpha")
+
+
+def _parse_ipv6(s):
+    if not all(c in HEX or c in ":." for c in s):
+        raise Invalid("ipv6 char")
+    if s.count(":::") or s.count("::") > 1:
+        raise Invalid("multi ::")
+    if s.startswith(":") and not s.startswith("::"):
+        raise Invalid("lead colon")
+    if s.endswith(":") and not s.endswith("::"):
+        raise Invalid("tail colon")
+    has_dc = "::" in s
+    groups = [g for g in s.split(":") if g]
+    nbytes = 0
+    for gi, g in enumerate(groups):
+        if "." in g:
+            if gi != len(groups) - 1 or not _parse_ipv4(g):
+                raise Invalid("bad v4-in-v6")
+            nbytes += 4
+        else:
+            if not (1 <= len(g) <= 4 and all(c in HEX for c in g)):
+                raise Invalid("group")
+            nbytes += 2
+    if has_dc:
+        if nbytes > 14:
+            raise Invalid("too long")
+    elif nbytes != 16:
+        raise Invalid("wrong length")
+
+
+def _parse_server(auth):
+    # userinfo
+    host_part = auth
+    if "@" in auth:
+        userinfo, host_part = auth.split("@", 1)
+        _check(userinfo, USERINFO_CH)
+    if host_part.startswith("["):
+        rb = host_part.find("]")
+        if rb < 0:
+            raise Invalid("no ]")
+        _parse_ipv6(host_part[1:rb])
+        rest = host_part[rb + 1:]
+        if rest:
+            if not rest.startswith(":") or not all(c in DIGIT
+                                                   for c in rest[1:]):
+                raise Invalid("port")
+        return host_part[:rb + 1]
+    # split on the last ':' for the port
+    if ":" in host_part:
+        host, port = host_part.rsplit(":", 1)
+        if not all(c in DIGIT for c in port):
+            raise Invalid("port")
+    else:
+        host = host_part
+    if not _parse_ipv4(host):
+        _parse_hostname(host)
+    return host
+
+
+def java_uri(s):
+    """(scheme, host, raw_query) per java.net.URI; (None,)*3 if invalid."""
+    if s is None:
+        return None, None, None
+    try:
+        scheme = host = query = None
+        # fragment = after first '#'
+        hash_i = s.find("#")
+        body, frag = (s, None) if hash_i < 0 else (s[:hash_i], s[hash_i + 1:])
+        if frag is not None:
+            _check(frag, URIC)
+        # scheme iff ':' precedes any '/?#' (within body by construction)
+        delim = len(s)
+        for i, ch in enumerate(s):
+            if ch in "/?#":
+                delim = i
+                break
+        colon = s.find(":")
+        rest = body
+        if 0 <= colon < delim:
+            scheme = s[:colon]
+            if not scheme or scheme[0] not in ALPHA:
+                raise Invalid("scheme")
+            _check(scheme[1:], SCHEME_CH, escapes=False, other=False)
+            rest = body[colon + 1:]
+            if not rest:
+                raise Invalid("empty ssp")
+            if not rest.startswith("/"):
+                # opaque
+                _check(rest, URIC)
+                return scheme, None, None
+        elif colon == 0:
+            raise Invalid("expected scheme")
+        # hierarchical
+        if rest.startswith("//"):
+            after = rest[2:]
+            end = len(after)
+            for i, ch in enumerate(after):
+                if ch in "/?#":
+                    end = i
+                    break
+            auth, rest = after[:end], after[end:]
+            if not auth:
+                if not rest:
+                    raise Invalid("expected authority")
+            else:
+                try:
+                    host = _parse_server(auth)
+                except Invalid:
+                    host = None
+                    _check(auth, REG_CH | {"@"})
+        # path / query
+        q_i = rest.find("?")
+        path, query = (rest, None) if q_i < 0 else (rest[:q_i], rest[q_i + 1:])
+        _check(path, PATH_CH)
+        if query is not None:
+            _check(query, URIC)
+        return scheme, host, query
+    except Invalid:
+        return None, None, None
+
+
+def query_param(raw_query, param, require_nonempty_key):
+    """Raw-byte pair matching: a pair matches when the text at a pair start
+    (query start or just after '&') is exactly `param` + '='. This is the
+    reference kernel's semantics (parse_uri.cu find_query_part:495) and
+    Spark's quoted-key regex; it agrees with ParseURITest's split-based
+    expectations for every param that contains no '&' or '='."""
+    if raw_query is None or param is None:
+        return None
+    if require_nonempty_key and not param:
+        return None
+    starts = [0] + [i + 1 for i, c in enumerate(raw_query) if c == "&"]
+    for s in starts:
+        if raw_query.startswith(param + "=", s):
+            vstart = s + len(param) + 1
+            vend = raw_query.find("&", vstart)
+            return raw_query[vstart:] if vend < 0 else raw_query[vstart:vend]
+    return None

@@ -1,0 +1,167 @@
+"""Histogram / percentile tests.
+
+Oracle: a scalar Python reimplementation of Spark's percentile-from-histogram
+evaluation (sort nulls-last, prefix counts, floor/ceil interpolation) — the
+role the Spark CPU implementation plays for the reference's gtests.
+"""
+import math
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import dtypes
+from spark_rapids_tpu.columnar import Column
+from spark_rapids_tpu.ops.histogram import (create_histogram_if_valid,
+                                            percentile_from_histogram)
+
+
+def percentile_oracle(pairs, percentages):
+    """pairs: [(value_or_None, freq)] for one histogram."""
+    live = sorted([p for p in pairs if p[0] is not None])
+    if not live:
+        return [None] * len(percentages)
+    acc = []
+    total = 0
+    for v, f in live:
+        total += f
+        acc.append(total)
+    out = []
+    for pct in percentages:
+        max_pos = acc[-1] - 1
+        position = max_pos * pct
+        lo, hi = math.floor(position), math.ceil(position)
+
+        def elem(target):
+            for i, a in enumerate(acc):
+                if a >= target:
+                    return live[i][0]
+            return live[-1][0]
+
+        lo_el = elem(lo + 1)
+        if hi == lo:
+            out.append(float(lo_el))
+            continue
+        hi_el = elem(hi + 1)
+        if hi_el == lo_el:
+            out.append(float(lo_el))
+            continue
+        out.append((hi - position) * lo_el + (position - lo) * hi_el)
+    return out
+
+
+def make_histograms(hists, dtype=dtypes.INT32):
+    """hists: list of [(value_or_None, freq)] -> LIST<STRUCT> column."""
+    values, freqs, offs = [], [], [0]
+    for h in hists:
+        for v, f in h:
+            values.append(v)
+            freqs.append(f)
+        offs.append(len(values))
+    struct = Column.make_struct(
+        value=Column.from_pylist(values, dtype),
+        freq=Column.from_pylist(freqs, dtypes.INT64))
+    return Column.make_list(np.array(offs, np.int32), struct)
+
+
+def test_create_histogram_struct():
+    v = Column.from_pylist([1, 2, None, 4], dtypes.INT32)
+    f = Column.from_pylist([5, 0, 3, 2], dtypes.INT64)
+    out = create_histogram_if_valid(v, f, False)
+    got = out.to_pylist()
+    # freq-0 row nullified; null rows get freq 1
+    assert got == [{"value": 1, "freq": 5}, {"value": None, "freq": 1},
+                   {"value": None, "freq": 1}, {"value": 4, "freq": 2}]
+
+
+def test_create_histogram_lists():
+    v = Column.from_pylist([1, 2, 3], dtypes.INT32)
+    f = Column.from_pylist([5, 0, 2], dtypes.INT64)
+    out = create_histogram_if_valid(v, f, True)
+    got = out.to_pylist()
+    assert got == [[{"value": 1, "freq": 5}], [], [{"value": 3, "freq": 2}]]
+
+
+def test_create_histogram_validation():
+    v = Column.from_pylist([1], dtypes.INT32)
+    with pytest.raises(TypeError):
+        create_histogram_if_valid(v, Column.from_pylist([1], dtypes.INT32),
+                                  False)
+    with pytest.raises(ValueError):
+        create_histogram_if_valid(v, Column.from_pylist([-1], dtypes.INT64),
+                                  False)
+    with pytest.raises(ValueError):
+        create_histogram_if_valid(v, Column.from_pylist([None], dtypes.INT64),
+                                  False)
+
+
+@pytest.mark.parametrize("pcts", [[0.5], [0.0, 0.25, 0.5, 0.75, 1.0]])
+def test_percentile_matches_oracle(pcts):
+    hists = [
+        [(10, 1), (20, 1), (30, 1)],
+        [(5, 10)],
+        [(1, 1), (100, 99)],
+        [(None, 1), (7, 3), (2, 2)],
+        [(None, 1)],
+        [(-5, 2), (0, 1), (5, 2)],
+    ]
+    col = make_histograms(hists)
+    out = percentile_from_histogram(col, pcts, True)
+    got = out.to_pylist()
+    want = [percentile_oracle(h, pcts) for h in hists]
+    for g, w in zip(got, want):
+        if all(x is None for x in w):
+            assert g is None        # all-null histogram -> null list row
+        else:
+            assert g == pytest.approx(w)
+
+
+def test_percentile_random_vs_oracle():
+    rng = np.random.default_rng(3)
+    hists = []
+    for _ in range(50):
+        k = rng.integers(1, 8)
+        hist = sorted(
+            (int(v), int(f)) for v, f in zip(
+                rng.integers(-100, 100, k), rng.integers(1, 20, k)))
+        hists.append(hist)
+    pcts = [0.0, 0.1, 0.33, 0.5, 0.9, 1.0]
+    got = percentile_from_histogram(make_histograms(hists), pcts,
+                                    True).to_pylist()
+    for g, h in zip(got, hists):
+        assert g == pytest.approx(percentile_oracle(h, pcts))
+
+
+def test_percentile_flat_output():
+    hists = [[(1, 1), (2, 1)], [(None, 1)]]
+    out = percentile_from_histogram(make_histograms(hists), [0.5], False)
+    assert out.to_pylist() == [1.5, None]
+
+
+def test_percentile_float_values():
+    hists = [[(0.5, 2), (1.5, 3)]]
+    got = percentile_from_histogram(make_histograms(hists, dtypes.FLOAT64),
+                                    [0.5], True).to_pylist()
+    assert got == [pytest.approx(percentile_oracle(hists[0], [0.5]))]
+
+
+def test_percentile_all_empty_batch():
+    col = make_histograms([[], []])
+    got = percentile_from_histogram(col, [0.5], True).to_pylist()
+    assert got == [None, None]
+    got = percentile_from_histogram(col, [0.5], False).to_pylist()
+    assert got == [None, None]
+
+
+def test_create_histogram_no_zero_freq_passthrough():
+    # without zero frequencies, pre-existing nulls keep their frequency
+    # (histogram.cu:416-418 early return)
+    v = Column.from_pylist([None, 2], dtypes.INT32)
+    f = Column.from_pylist([5, 3], dtypes.INT64)
+    got = create_histogram_if_valid(v, f, False).to_pylist()
+    assert got == [{"value": None, "freq": 5}, {"value": 2, "freq": 3}]
+
+
+def test_percentile_validation():
+    with pytest.raises(TypeError):
+        percentile_from_histogram(Column.from_pylist([1], dtypes.INT32),
+                                  [0.5], False)
