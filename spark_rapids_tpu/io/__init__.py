@@ -1,0 +1,5 @@
+from .parquet_footer import (ParquetFooter, StructElement, ListElement,
+                             MapElement, ValueElement)
+
+__all__ = ["ParquetFooter", "StructElement", "ListElement", "MapElement",
+           "ValueElement"]

@@ -1,0 +1,187 @@
+"""Parquet footer parse / prune / filter / re-serialize.
+
+Python facade over native/parquet_footer.cpp, mirroring the reference's
+ParquetFooter.java surface: a schema DSL (StructElement/ListElement/
+MapElement/ValueElement, ParquetFooter.java:34-118) flattened depth-first
+into names/num_children/tags arrays (tags 0=VALUE 1=STRUCT 2=LIST 3=MAP,
+:139-179), readAndFilter(buffer, partOffset, partLength, schema,
+ignoreCase) (:204), and serializeThriftFile returning the
+[thrift][4-byte length][PAR1] framing (NativeParquetJni.cpp:793-830).
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import List, Sequence, Tuple
+
+from ..native.build import build
+
+
+class ValueElement:
+    """A primitive leaf column."""
+
+
+class StructElement:
+    def __init__(self, **children):
+        self.children: List[Tuple[str, object]] = list(children.items())
+
+    @staticmethod
+    def of(children: Sequence[Tuple[str, object]]) -> "StructElement":
+        s = StructElement()
+        s.children = list(children)
+        return s
+
+
+class ListElement:
+    def __init__(self, item):
+        self.item = item
+
+
+class MapElement:
+    def __init__(self, key, value):
+        self.key = key
+        self.value = value
+
+
+def _flatten(element, name: str, lower: bool, names, num_children, tags):
+    if lower:
+        name = name.lower()
+    if isinstance(element, ValueElement):
+        names.append(name)
+        num_children.append(0)
+        tags.append(0)
+    elif isinstance(element, StructElement):
+        names.append(name)
+        num_children.append(len(element.children))
+        tags.append(1)
+        for child_name, child in element.children:
+            _flatten(child, child_name, lower, names, num_children, tags)
+    elif isinstance(element, ListElement):
+        names.append(name)
+        num_children.append(1)
+        tags.append(2)
+        _flatten(element.item, "element", lower, names, num_children, tags)
+    elif isinstance(element, MapElement):
+        names.append(name)
+        num_children.append(2)
+        tags.append(3)
+        _flatten(element.key, "key", lower, names, num_children, tags)
+        _flatten(element.value, "value", lower, names, num_children, tags)
+    else:
+        raise TypeError(f"{element!r} is not a supported schema element")
+
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _native():
+    global _lib
+    if _lib is None:
+        with _lib_lock:
+            if _lib is None:
+                lib = ctypes.CDLL(build("parquet_footer"))
+                lib.pqf_parse.restype = ctypes.c_void_p
+                lib.pqf_parse.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+                lib.pqf_last_error.restype = ctypes.c_char_p
+                lib.pqf_filter_groups.argtypes = [ctypes.c_void_p,
+                                                  ctypes.c_int64,
+                                                  ctypes.c_int64]
+                lib.pqf_prune.argtypes = [
+                    ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+                    ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                    ctypes.c_int, ctypes.c_int]
+                lib.pqf_num_rows.restype = ctypes.c_int64
+                lib.pqf_num_rows.argtypes = [ctypes.c_void_p]
+                lib.pqf_num_row_groups.argtypes = [ctypes.c_void_p]
+                lib.pqf_num_columns.argtypes = [ctypes.c_void_p]
+                lib.pqf_serialize.restype = ctypes.c_int64
+                lib.pqf_serialize.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                              ctypes.c_int64]
+                lib.pqf_free.argtypes = [ctypes.c_void_p]
+                _lib = lib
+    return _lib
+
+
+class ParquetFooter:
+    """A parsed, filtered parquet footer (reference ParquetFooter.java)."""
+
+    def __init__(self, handle: int):
+        self._lib = _native()
+        self._h = handle
+
+    @staticmethod
+    def read_and_filter(buffer: bytes, part_offset: int, part_length: int,
+                        schema: StructElement,
+                        ignore_case: bool) -> "ParquetFooter":
+        """Parse a footer thrift buffer, prune to `schema`, and keep only
+        the row groups whose byte midpoint falls inside
+        [part_offset, part_offset + part_length)."""
+        lib = _native()
+        h = lib.pqf_parse(buffer, len(buffer))
+        if not h:
+            raise ValueError(lib.pqf_last_error().decode())
+        footer = ParquetFooter(h)
+        try:
+            footer._filter_groups(part_offset, part_length)
+            footer._prune(schema, ignore_case)
+        except Exception:
+            footer.close()
+            raise
+        return footer
+
+    def _filter_groups(self, part_offset: int, part_length: int) -> None:
+        if self._lib.pqf_filter_groups(self._h, part_offset, part_length):
+            raise ValueError(self._lib.pqf_last_error().decode())
+
+    def _prune(self, schema: StructElement, ignore_case: bool) -> None:
+        names: List[str] = []
+        num_children: List[int] = []
+        tags: List[int] = []
+        for child_name, child in schema.children:
+            _flatten(child, child_name, ignore_case, names, num_children,
+                     tags)
+        n = len(names)
+        c_names = (ctypes.c_char_p * n)(*[s.encode() for s in names])
+        c_nc = (ctypes.c_int * n)(*num_children)
+        c_tags = (ctypes.c_int * n)(*tags)
+        if self._lib.pqf_prune(self._h, c_names, c_nc, c_tags, n,
+                               int(ignore_case)):
+            raise ValueError(self._lib.pqf_last_error().decode())
+
+    def get_num_rows(self) -> int:
+        return self._lib.pqf_num_rows(self._h)
+
+    def get_num_columns(self) -> int:
+        return self._lib.pqf_num_columns(self._h)
+
+    def get_num_row_groups(self) -> int:
+        return self._lib.pqf_num_row_groups(self._h)
+
+    def serialize_thrift_file(self) -> bytes:
+        """Filtered footer as [thrift][4-byte LE length]["PAR1"]."""
+        size = self._lib.pqf_serialize(self._h, None, 0)
+        if size < 0:
+            raise ValueError(self._lib.pqf_last_error().decode())
+        buf = ctypes.create_string_buffer(size)
+        got = self._lib.pqf_serialize(self._h, buf, size)
+        if got < 0:
+            raise ValueError(self._lib.pqf_last_error().decode())
+        return buf.raw[:got]
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.pqf_free(self._h)
+            self._h = 0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -15,6 +15,7 @@ _LOCK = threading.Lock()
 
 _SOURCES = {
     "resource_adaptor": ["resource_adaptor.cpp"],
+    "parquet_footer": ["parquet_footer.cpp"],
 }
 
 

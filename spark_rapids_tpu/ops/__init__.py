@@ -25,6 +25,7 @@ from .parse_uri import (parse_uri_to_protocol, parse_uri_to_host,
                         parse_uri_to_query, parse_uri_to_query_literal,
                         parse_uri_to_query_column)
 from .histogram import create_histogram_if_valid, percentile_from_histogram
+from .map_utils import from_json
 
 __all__ = [
     "murmur_hash3_32", "xxhash64", "DEFAULT_XXHASH64_SEED",
@@ -45,4 +46,5 @@ __all__ = [
     "parse_uri_to_protocol", "parse_uri_to_host", "parse_uri_to_query",
     "parse_uri_to_query_literal", "parse_uri_to_query_column",
     "create_histogram_if_valid", "percentile_from_histogram",
+    "from_json",
 ]

@@ -1,0 +1,199 @@
+"""Parquet footer parse/prune/filter tests.
+
+Oracle: pyarrow — footers come from real files pyarrow wrote, and every
+filtered footer this code serializes is spliced back into the file and
+re-read with pyarrow (the role parquet-avro plays for the reference's
+Java tests, SURVEY.md §4).
+"""
+import io
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.io import (ParquetFooter, StructElement, ListElement,
+                                 MapElement, ValueElement)
+
+
+def write_parquet(table, row_group_size=None) -> bytes:
+    sink = io.BytesIO()
+    pq.write_table(table, sink, row_group_size=row_group_size,
+                   compression="NONE")
+    return sink.getvalue()
+
+
+def footer_bytes(file_bytes: bytes) -> bytes:
+    assert file_bytes[-4:] == b"PAR1"
+    n = int.from_bytes(file_bytes[-8:-4], "little")
+    return file_bytes[-8 - n:-8]
+
+
+def splice_footer(file_bytes: bytes, serialized: bytes) -> bytes:
+    n = int.from_bytes(file_bytes[-8:-4], "little")
+    return file_bytes[: len(file_bytes) - 8 - n] + serialized
+
+
+def simple_table(n=1000):
+    return pa.table({
+        "a": pa.array(range(n), pa.int64()),
+        "b": pa.array([f"s{i}" for i in range(n)], pa.string()),
+        "c": pa.array([i * 0.5 for i in range(n)], pa.float64()),
+    })
+
+
+def test_roundtrip_identity():
+    data = write_parquet(simple_table())
+    schema = StructElement(a=ValueElement(), b=ValueElement(),
+                           c=ValueElement())
+    with ParquetFooter.read_and_filter(footer_bytes(data), 0, len(data),
+                                       schema, False) as f:
+        assert f.get_num_rows() == 1000
+        assert f.get_num_columns() == 3
+        assert f.get_num_row_groups() == 1
+        new = splice_footer(data, f.serialize_thrift_file())
+    md = pq.read_metadata(io.BytesIO(new))
+    assert md.num_rows == 1000
+    assert md.num_columns == 3
+    got = pq.read_table(io.BytesIO(new))
+    assert got.equals(simple_table())
+
+
+def test_prune_columns():
+    data = write_parquet(simple_table())
+    schema = StructElement(c=ValueElement(), a=ValueElement())
+    with ParquetFooter.read_and_filter(footer_bytes(data), 0, len(data),
+                                       schema, False) as f:
+        assert f.get_num_columns() == 2
+        new = splice_footer(data, f.serialize_thrift_file())
+    got = pq.read_table(io.BytesIO(new))
+    # parquet order retained: a before c
+    assert got.column_names == ["a", "c"]
+    assert got["a"].to_pylist() == list(range(1000))
+    assert got["c"].to_pylist() == [i * 0.5 for i in range(1000)]
+
+
+def test_case_insensitive_prune():
+    data = write_parquet(pa.table({"MixedCase": pa.array([1, 2, 3])}))
+    schema = StructElement(mixedcase=ValueElement())
+    with ParquetFooter.read_and_filter(footer_bytes(data), 0, len(data),
+                                       schema, True) as f:
+        assert f.get_num_columns() == 1
+    # case-sensitive: no match -> zero columns
+    with ParquetFooter.read_and_filter(footer_bytes(data), 0, len(data),
+                                       StructElement(mixedcase=ValueElement()),
+                                       False) as f:
+        assert f.get_num_columns() == 0
+
+
+def test_missing_column_skipped():
+    data = write_parquet(simple_table())
+    schema = StructElement(a=ValueElement(), zz=ValueElement())
+    with ParquetFooter.read_and_filter(footer_bytes(data), 0, len(data),
+                                       schema, False) as f:
+        assert f.get_num_columns() == 1
+
+
+def test_row_group_filter_by_midpoint():
+    data = write_parquet(simple_table(10_000), row_group_size=1000)
+    md = pq.read_metadata(io.BytesIO(data))
+    assert md.num_row_groups == 10
+    # compute each group's midpoint the same way Spark does
+    fb = footer_bytes(data)
+    schema = StructElement(a=ValueElement(), b=ValueElement(),
+                           c=ValueElement())
+    # whole file -> all groups
+    with ParquetFooter.read_and_filter(fb, 0, len(data), schema, False) as f:
+        assert f.get_num_row_groups() == 10
+        assert f.get_num_rows() == 10_000
+    # split covering no midpoints -> nothing
+    with ParquetFooter.read_and_filter(fb, len(data) + 10, 5, schema,
+                                       False) as f:
+        assert f.get_num_row_groups() == 0
+        assert f.get_num_rows() == 0
+    # half the file -> roughly half the groups; verify exact containment
+    starts = []
+    sizes = []
+    for g in range(10):
+        rg = md.row_group(g)
+        s = min(
+            (rg.column(c).dictionary_page_offset
+             if rg.column(c).dictionary_page_offset is not None
+             else rg.column(c).data_page_offset)
+            for c in range(rg.num_columns))
+        starts.append(s)
+        sizes.append(sum(rg.column(c).total_compressed_size
+                         for c in range(rg.num_columns)))
+    half = len(data) // 2
+    want = sum(1 for s, z in zip(starts, sizes) if 0 <= s + z // 2 < half)
+    with ParquetFooter.read_and_filter(fb, 0, half, schema, False) as f:
+        assert f.get_num_row_groups() == want
+        new = splice_footer(data, f.serialize_thrift_file())
+    got = pq.read_table(io.BytesIO(new))
+    assert got.num_rows == want * 1000
+
+
+def test_nested_struct_prune():
+    table = pa.table({
+        "s": pa.array([{"x": 1, "y": "a", "z": 2.0}] * 10),
+        "p": pa.array(range(10)),
+    })
+    data = write_parquet(table)
+    schema = StructElement(
+        s=StructElement(x=ValueElement(), z=ValueElement()))
+    with ParquetFooter.read_and_filter(footer_bytes(data), 0, len(data),
+                                       schema, False) as f:
+        assert f.get_num_columns() == 1
+        new = splice_footer(data, f.serialize_thrift_file())
+    got = pq.read_table(io.BytesIO(new))
+    assert got.column_names == ["s"]
+    assert got["s"].to_pylist() == [{"x": 1, "z": 2.0}] * 10
+
+
+def test_list_and_map_prune():
+    table = pa.table({
+        "l": pa.array([[1, 2], [3]], pa.list_(pa.int64())),
+        "m": pa.array([[("k", 7)], [("q", 8)]],
+                      pa.map_(pa.string(), pa.int64())),
+        "v": pa.array([1, 2]),
+    })
+    data = write_parquet(table)
+    schema = StructElement(
+        l=ListElement(ValueElement()),
+        m=MapElement(ValueElement(), ValueElement()))
+    with ParquetFooter.read_and_filter(footer_bytes(data), 0, len(data),
+                                       schema, False) as f:
+        assert f.get_num_columns() == 2
+        new = splice_footer(data, f.serialize_thrift_file())
+    got = pq.read_table(io.BytesIO(new))
+    assert got.column_names == ["l", "m"]
+    assert got["l"].to_pylist() == [[1, 2], [3]]
+    assert got["m"].to_pylist() == [[("k", 7)], [("q", 8)]]
+
+
+def test_list_of_struct_inner_prune():
+    table = pa.table({
+        "ls": pa.array([[{"u": 1, "w": 2}], [{"u": 3, "w": 4}]],
+                       pa.list_(pa.struct([("u", pa.int64()),
+                                           ("w", pa.int64())]))),
+    })
+    data = write_parquet(table)
+    schema = StructElement(ls=ListElement(StructElement(w=ValueElement())))
+    with ParquetFooter.read_and_filter(footer_bytes(data), 0, len(data),
+                                       schema, False) as f:
+        new = splice_footer(data, f.serialize_thrift_file())
+    got = pq.read_table(io.BytesIO(new))
+    assert got["ls"].to_pylist() == [[{"w": 2}], [{"w": 4}]]
+
+
+def test_type_mismatch_raises():
+    data = write_parquet(simple_table())
+    with pytest.raises(ValueError):
+        ParquetFooter.read_and_filter(
+            footer_bytes(data), 0, len(data),
+            StructElement(a=StructElement(x=ValueElement())), False)
+
+
+def test_garbage_buffer_raises():
+    with pytest.raises(ValueError):
+        ParquetFooter.read_and_filter(b"\x99\x88\x77", 0, 10,
+                                      StructElement(a=ValueElement()), False)
