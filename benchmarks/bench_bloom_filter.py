@@ -1,0 +1,38 @@
+"""Bloom filter put bench (reference benchmarks/bloom_filter.cu).
+
+Axis: bloom_filter_bytes {512KiB..8MiB} at fixed row count (reference uses
+150M rows / 3 hashes; we scale rows with --scale). Input is xxhash64 of a
+random INT64 column, exactly like the reference (:38-39).
+"""
+import sys
+
+sys.path.insert(0, ".")
+from benchmarks.common import parse_args, random_fixed_table, run_config  # noqa: E402
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    from spark_rapids_tpu import dtypes
+    from spark_rapids_tpu.ops import (bloom_filter_create, bloom_filter_probe,
+                                      bloom_filter_put, xxhash64)
+
+    num_rows = max(int(150_000_000 * args.scale / 10), 4096)
+    num_hashes = 3
+    src = random_fixed_table([dtypes.INT64], num_rows, seed=11)
+    hashed = xxhash64(src)
+
+    for bf_bytes in (512 << 10, 1 << 20, 2 << 20, 4 << 20, 8 << 20):
+        bf = bloom_filter_create(num_hashes, bf_bytes // 8)
+        run_config("bloom_filter_put",
+                   {"bloom_filter_bytes": bf_bytes, "num_rows": num_rows},
+                   lambda c, b=bf: bloom_filter_put(b, c).bits,
+                   (hashed,), n_rows=num_rows, iters=args.iters)
+        full = bloom_filter_put(bf, hashed)
+        run_config("bloom_filter_probe",
+                   {"bloom_filter_bytes": bf_bytes, "num_rows": num_rows},
+                   lambda c, b=full: bloom_filter_probe(c, b).data,
+                   (hashed,), n_rows=num_rows, iters=args.iters)
+
+
+if __name__ == "__main__":
+    main()

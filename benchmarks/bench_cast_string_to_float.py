@@ -1,0 +1,25 @@
+"""String→float cast bench (reference benchmarks/cast_string_to_float.cpp).
+
+Axis: num_rows {1M, 100M} (reference :42-44), input = printed random floats.
+"""
+import sys
+
+sys.path.insert(0, ".")
+from benchmarks.common import parse_args, random_float_strings, run_config  # noqa: E402
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    from spark_rapids_tpu import dtypes
+    from spark_rapids_tpu.ops import string_to_float
+
+    for n_rows in (max(int(1_048_576 * args.scale), 1024),
+                   max(int(104_857_600 * args.scale), 2048)):
+        col = random_float_strings(n_rows, seed=3)
+        run_config("string_to_float", {"num_rows": n_rows},
+                   lambda c: string_to_float(c, dtypes.FLOAT32).data,
+                   (col,), n_rows=n_rows, iters=args.iters)
+
+
+if __name__ == "__main__":
+    main()

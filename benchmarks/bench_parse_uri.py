@@ -1,0 +1,42 @@
+"""parse_uri bench (reference benchmarks/parse_uri.cpp).
+
+Two variants like the reference: random strings (bench_random_parse_uri) and
+a valid/garbage/unicode mix swept over a hit_rate axis (bench_parse_uri).
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from benchmarks.common import (parse_args, run_config,  # noqa: E402
+                               strings_column_from_list, uri_mix)
+
+
+def _random_strings(n_rows, seed=0):
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(0, 32, size=n_rows)
+    alphabet = np.frombuffer(
+        b"abcdefghijklmnopqrstuvwxyz0123456789:/?.&=%", dtype=np.uint8)
+    return strings_column_from_list(
+        [rng.choice(alphabet, size=l).tobytes() for l in lens])
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    from spark_rapids_tpu.ops import parse_uri_to_protocol
+
+    n_rows = max(int(1_048_576 * args.scale), 2048)
+    col = _random_strings(n_rows, seed=5)
+    run_config("parse_uri_random", {"num_rows": n_rows},
+               lambda c: parse_uri_to_protocol(c).data,
+               (col,), n_rows=n_rows, iters=args.iters)
+
+    for hit_rate in (0, 50, 100):
+        col = uri_mix(n_rows, hit_rate, seed=6)
+        run_config("parse_uri", {"num_rows": n_rows, "hit_rate": hit_rate},
+                   lambda c: parse_uri_to_protocol(c).data,
+                   (col,), n_rows=n_rows, iters=args.iters)
+
+
+if __name__ == "__main__":
+    main()

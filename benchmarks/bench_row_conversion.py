@@ -1,0 +1,53 @@
+"""Row↔columnar conversion bench (reference benchmarks/row_conversion.cpp).
+
+Axes: num_rows × direction, over the reference's 9-dtype cycle. The general
+path runs at 216 columns (reference cycles its 9 dtypes ×212); the
+fixed-width-optimized path at 24 columns (it enforces <100 columns / ≤1KB
+rows — RowConversion.java:32-34).
+"""
+import sys
+
+sys.path.insert(0, ".")
+from benchmarks.common import parse_args, random_fixed_table, run_config  # noqa: E402
+
+CYCLE = None  # filled in main() once dtypes is importable
+
+
+def _table(n_cols, n_rows):
+    from spark_rapids_tpu import dtypes
+    cycle = [dtypes.INT8, dtypes.INT32, dtypes.INT16, dtypes.INT64,
+             dtypes.INT32, dtypes.BOOL, dtypes.INT16, dtypes.INT8,
+             dtypes.INT64]
+    return random_fixed_table([cycle[i % len(cycle)] for i in range(n_cols)],
+                              n_rows, seed=7)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    from spark_rapids_tpu.ops import (convert_from_rows, convert_to_rows,
+                                      convert_to_rows_fixed_width_optimized)
+
+    for variant, n_cols, to_rows in (
+            ("general", 216, convert_to_rows),
+            ("fixed_width_optimized", 24, convert_to_rows_fixed_width_optimized)):
+        for n_rows in (max(int(262_144 * args.scale), 1024),
+                       max(int(1_048_576 * args.scale), 2048)):
+            table = _table(n_cols, n_rows)
+            schema = [c.dtype for c in table.columns]
+            rows = to_rows(table)[0]
+
+            run_config("row_conversion",
+                       {"variant": variant, "num_rows": n_rows,
+                        "num_cols": n_cols, "direction": "to row"},
+                       lambda t, f=to_rows: f(t)[0].children[0].data,
+                       (table,), n_rows=n_rows, iters=args.iters)
+            run_config("row_conversion",
+                       {"variant": variant, "num_rows": n_rows,
+                        "num_cols": n_cols, "direction": "from row"},
+                       lambda r, s=schema: [c.data for c in
+                                            convert_from_rows(r, s).columns],
+                       (rows,), n_rows=n_rows, iters=args.iters)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Shared micro-benchmark harness + random data generation.
+
+Plays the role of the reference's nvbench + benchmarks/common/generate_input.cu
+(SURVEY.md §2.3): every bench file declares configs over named axes, times the
+op on-device with warmup (first call compiles under jit; steady-state is what
+we report, like nvbench's cold/batched split), and prints one JSON line per
+config:
+
+    {"bench": ..., "axes": {...}, "ms": ..., "rows_per_s": ...}
+
+Run any bench file directly, or all of them via `python benchmarks/run_all.py`.
+`--scale` shrinks row counts (CI smoke / CPU runs).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Callable, Dict, Iterable, List, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0,
+                    help="multiply all num_rows axes by this (e.g. 0.01 for smoke)")
+    ap.add_argument("--iters", type=int, default=10)
+    return ap.parse_args(argv)
+
+
+def run_config(bench: str, axes: Dict, fn: Callable, args, *, n_rows: int,
+               iters: int = 10) -> Dict:
+    """Time fn(*args) steady-state; returns + prints the result record."""
+    out = fn(*args)
+    jax.block_until_ready(out)          # compile + warmup
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    ms = (time.perf_counter() - t0) * 1e3 / iters
+    rec = {"bench": bench, "axes": axes, "ms": round(ms, 3),
+           "rows_per_s": round(n_rows / (ms * 1e-3))}
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+# ---- datagen ----------------------------------------------------------------
+
+def random_fixed_table(dts: Sequence, n_rows: int, seed: int = 0):
+    """Random Table over fixed-width dtypes (reference create_random_table)."""
+    from spark_rapids_tpu import Column, dtypes
+    from spark_rapids_tpu.columnar import Table
+
+    rng = np.random.default_rng(seed)
+    cols = []
+    for i, dt in enumerate(dts):
+        np_dt = np.dtype(dt.storage_dtype())
+        if np_dt.kind in "iu":
+            info = np.iinfo(np_dt)
+            arr = rng.integers(info.min, info.max, size=n_rows, dtype=np_dt,
+                               endpoint=True)
+        elif np_dt.kind == "f":
+            arr = rng.standard_normal(n_rows).astype(np_dt) * 1e3
+        elif np_dt.kind == "b":
+            arr = rng.integers(0, 2, size=n_rows).astype(bool)
+        else:
+            raise TypeError(f"unsupported bench dtype {dt}")
+        cols.append(Column(dtype=dt, length=n_rows, data=jnp.asarray(arr)))
+    return Table(cols)
+
+
+def strings_column_from_list(strs: List[bytes]):
+    """Fast path: build a string Column from a list of byte strings via one
+    concat + frombuffer, instead of per-row from_pylist."""
+    from spark_rapids_tpu.columnar.column import make_string_column
+
+    joined = b"".join(strs)
+    chars = np.frombuffer(joined, dtype=np.uint8)
+    lens = np.fromiter((len(s) for s in strs), dtype=np.int32, count=len(strs))
+    offsets = np.zeros(len(strs) + 1, dtype=np.int32)
+    np.cumsum(lens, out=offsets[1:])
+    return make_string_column(jnp.asarray(chars), jnp.asarray(offsets))
+
+
+def random_float_strings(n_rows: int, seed: int = 0):
+    """String column holding printed random floats (reference
+    cast_string_to_float.cpp:29-34: random FLOAT32 → from_floats)."""
+    rng = np.random.default_rng(seed)
+    vals = (rng.standard_normal(n_rows) * rng.choice(
+        [1e-3, 1.0, 1e4, 1e20], size=n_rows)).astype(np.float32)
+    txt = np.char.mod("%g", vals)
+    return strings_column_from_list([s.encode() for s in txt.tolist()])
+
+
+URI_VALID = (b"https://www.example.com/s/query?param0=0&param1=1&param2=2"
+             b"&param3=3&param4=4&param5=5&param6=6&param7=7&param8=8")
+URI_GARBAGE = [
+    b"abcdefghijklmnopqrstuvwxyz 01234" * 8,       # spaces: invalid
+    b"",                                           # empty
+    "AbcéDEFGHIJKLMNOPQRSTUVWXYZ 01".encode() * 8,  # unicode + spaces: invalid
+    b"9876543210,abcdefghijklmnopqrstU" * 8,       # no scheme
+]
+
+
+def uri_mix(n_rows: int, hit_rate: int, seed: int = 0):
+    """hit_rate% valid URIs, rest drawn from the garbage pool (reference
+    parse_uri.cpp bench_parse_uri hit_rate axis)."""
+    rng = np.random.default_rng(seed)
+    hits = rng.random(n_rows) < (hit_rate / 100.0)
+    pick = rng.integers(0, len(URI_GARBAGE), size=n_rows)
+    strs = [URI_VALID if h else URI_GARBAGE[p] for h, p in zip(hits, pick)]
+    return strings_column_from_list(strs)

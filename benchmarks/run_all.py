@@ -1,0 +1,20 @@
+"""Run every micro-bench (reference: the nvbench executables built by
+benchmarks/CMakeLists.txt). `python benchmarks/run_all.py --scale 0.01` for a
+CPU smoke pass."""
+import sys
+
+sys.path.insert(0, ".")
+
+from benchmarks import (bench_bloom_filter, bench_cast_string_to_float,  # noqa: E402
+                        bench_parse_uri, bench_row_conversion)
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    for mod in (bench_row_conversion, bench_cast_string_to_float,
+                bench_bloom_filter, bench_parse_uri):
+        mod.main(argv)
+
+
+if __name__ == "__main__":
+    main()

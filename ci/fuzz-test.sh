@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Arbiter fuzz tier (reference: ci/fuzz-test.sh runs RmmSparkMonteCarlo
+# --taskMaxMiB=2048 --gpuMiB=3072 --skewed --allocMode=ASYNC nightly).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python tools/monte_carlo.py --iterations 3 --tasks 64 --parallelism 12 \
+    --gpu-mib 3072 --task-max-mib 2048 --max-task-allocs 8 \
+    --shuffle-threads 4 --skewed --skew-amount 0.4
+echo "fuzz OK"

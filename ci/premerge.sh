@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Premerge gate (reference: ci/premerge-build.sh runs `mvn verify` with tests
+# on). Full unit suite on the 8-device CPU mesh + native build + bench smoke.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -c "import spark_rapids_tpu; print('import ok:', spark_rapids_tpu.__name__)"
+python -m pytest tests/ -x -q
+python benchmarks/run_all.py --scale 0.002 --iters 2
+python tools/monte_carlo.py --tasks 16 --parallelism 4 --gpu-mib 512 \
+    --task-max-mib 384 --shuffle-threads 2 --seed 1
+echo "premerge OK"

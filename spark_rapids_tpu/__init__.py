@@ -26,5 +26,6 @@ jax.config.update("jax_enable_x64", True)
 from . import dtypes                                    # noqa: E402
 from .columnar import Column, Table                     # noqa: E402
 
-__version__ = "0.1.0"
-__all__ = ["dtypes", "Column", "Table", "__version__"]
+from .version import __version__, version_info
+
+__all__ = ["dtypes", "Column", "Table", "__version__", "version_info"]

@@ -68,9 +68,11 @@ class MemoryBudget:
         """Blocking reservation: loops pre→reserve→post like the reference's
         do_allocate (SparkResourceAdaptorJni.cpp:1733-1754)."""
         nbytes = int(nbytes)
-        if nbytes > self.limit:
-            # can never fit: even infinite retries won't help
-            raise HardOOM(f"reservation of {nbytes} exceeds budget {self.limit}")
+        # NB: a reservation larger than the whole budget still goes through
+        # the state machine — the caller deserves its RetryOOM/SplitAndRetry
+        # escalations (splitting may shrink the request until it fits); the
+        # retry-limit watchdog bounds the livelock with a HardOOM, exactly
+        # like the reference's 500-retry cap (SparkResourceAdaptorJni.cpp:984).
         while True:
             r = self._attempt(nbytes, blocking=True)
             if r is not None:
