@@ -1,0 +1,32 @@
+"""Groupby hash-aggregate bench — BASELINE.json configs[1]: "groupby
+hash-aggregate (sum/count) on single int32 key, 10M rows"."""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from benchmarks.common import parse_args, run_config  # noqa: E402
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    import jax.numpy as jnp
+    from spark_rapids_tpu import Column, Table, dtypes
+    from spark_rapids_tpu.ops import groupby_aggregate
+
+    rng = np.random.default_rng(0)
+    for n_rows, n_keys in ((max(int(10_000_000 * args.scale), 4096), 100_000),
+                           (max(int(10_000_000 * args.scale), 4096), 100)):
+        k = Column(dtype=dtypes.INT32, length=n_rows,
+                   data=jnp.asarray(rng.integers(0, n_keys, n_rows, np.int32)))
+        v = Column(dtype=dtypes.INT64, length=n_rows,
+                   data=jnp.asarray(rng.integers(-10**9, 10**9, n_rows, np.int64)))
+        t = Table([k, v], names=["k", "v"])
+        run_config("groupby_sum_count", {"num_rows": n_rows, "num_keys": n_keys},
+                   lambda tb: [c.data for c in groupby_aggregate(
+                       tb, ["k"], [("v", "sum"), ("v", "count")]).columns],
+                   (t,), n_rows=n_rows, iters=args.iters)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Hash-join bench — BASELINE.json configs[2]: "hash inner-join on two
+int64-keyed tables, 10M×1M"."""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from benchmarks.common import parse_args, run_config  # noqa: E402
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    import jax.numpy as jnp
+    from spark_rapids_tpu import Column, dtypes
+    from spark_rapids_tpu.ops import inner_join
+
+    rng = np.random.default_rng(0)
+    nl = max(int(10_000_000 * args.scale), 8192)
+    nr = max(int(1_000_000 * args.scale), 1024)
+    # ~1 match per left row on average
+    lk = Column(dtype=dtypes.INT64, length=nl,
+                data=jnp.asarray(rng.integers(0, nr, nl, np.int64)))
+    rk = Column(dtype=dtypes.INT64, length=nr,
+                data=jnp.asarray(rng.permutation(nr).astype(np.int64)))
+    run_config("inner_join", {"left_rows": nl, "right_rows": nr},
+               lambda l, r: [c.data for c in inner_join([l], [r])],
+               (lk, rk), n_rows=nl, iters=args.iters)
+
+
+if __name__ == "__main__":
+    main()

@@ -29,3 +29,9 @@ from .columnar import Column, Table                     # noqa: E402
 from .version import __version__, version_info
 
 __all__ = ["dtypes", "Column", "Table", "__version__", "version_info"]
+
+# Fault-injector auto-load (reference: libcufaultinj.so via
+# CUDA_INJECTION64_PATH at cuInit — faultinj/README.md:20-24).
+from . import faultinj as _faultinj                     # noqa: E402
+
+_faultinj.maybe_install_from_env()

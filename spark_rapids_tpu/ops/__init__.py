@@ -26,6 +26,10 @@ from .parse_uri import (parse_uri_to_protocol, parse_uri_to_host,
                         parse_uri_to_query_column)
 from .histogram import create_histogram_if_valid, percentile_from_histogram
 from .map_utils import from_json
+from .gather import take, take_table
+from .sort import sorted_order, sort_table
+from .aggregate import groupby_aggregate
+from .join import inner_join, left_join, left_semi_join, left_anti_join
 
 __all__ = [
     "murmur_hash3_32", "xxhash64", "DEFAULT_XXHASH64_SEED",
@@ -47,4 +51,7 @@ __all__ = [
     "parse_uri_to_query_literal", "parse_uri_to_query_column",
     "create_histogram_if_valid", "percentile_from_histogram",
     "from_json",
+    "take", "take_table", "sorted_order", "sort_table",
+    "groupby_aggregate",
+    "inner_join", "left_join", "left_semi_join", "left_anti_join",
 ]

@@ -1,0 +1,202 @@
+"""Hash-join equivalent: equi-join gather maps with Spark null semantics
+(BASELINE.json configs[2]: "hash inner-join on two int64-keyed tables,
+10M×1M"; the reference stack gets joins from cudf's hash join, returning
+gather maps the plugin applies — the same contract here).
+
+TPU-first design: device hash tables fight the hardware (scatter-heavy,
+dynamic occupancy); XLA's sorter + searchsorted are native. The join is:
+
+1. union-rank the keys: concatenate left+right key columns, ONE
+   multi-operand `lax.sort` over their orderable operands (shared with
+   ops/sort.py, so cross-type normalization — NaN, -0.0, decimal limbs,
+   string words — is consistent), run-boundary prefix-sum → every row gets a
+   dense int32 rank; equal keys ⇔ equal ranks. This reduces any multi-column,
+   any-dtype equi-join to an int32 join.
+2. sort right ranks once; binary-search (searchsorted) each left rank for
+   its [lo, hi) match span — counts = hi - lo.
+3. expand: exclusive-scan the counts, then one searchsorted over the output
+   iota recovers (left row, k-th match) for every output slot. Both sides
+   come back as gather maps; -1 marks outer-join non-matches (take() turns
+   them into null rows).
+
+Null keys never match (Spark equi-join); null-safe equality (<=>) is the
+`null_equal` flag, like cudf's null_equality::EQUAL.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from .. import dtypes
+from ..columnar import Column, Table
+from ..dtypes import Kind
+from .sort import _key_operands
+
+__all__ = ["inner_join", "left_join", "left_semi_join", "left_anti_join"]
+
+
+def _concat_columns(a: Column, b: Column) -> Column:
+    """Concatenate two columns of the same dtype (cudf::concatenate)."""
+    if a.dtype != b.dtype:
+        # full equality: decimal keys with different scale/precision would
+        # otherwise be compared on raw unscaled values (cudf also rejects)
+        raise TypeError(f"join key dtype mismatch: {a.dtype} vs {b.dtype}")
+    n = a.length + b.length
+    if a.validity is not None or b.validity is not None:
+        va = a.validity if a.validity is not None else jnp.ones((a.length,), bool)
+        vb = b.validity if b.validity is not None else jnp.ones((b.length,), bool)
+        validity = jnp.concatenate([va, vb])
+    else:
+        validity = None
+    if a.dtype.kind == Kind.STRING:
+        chars = jnp.concatenate([a.data, b.data])
+        off_b = b.offsets[1:] + a.data.shape[0]
+        offsets = jnp.concatenate([a.offsets, off_b.astype(jnp.int32)])
+        return Column(dtype=a.dtype, length=n, data=chars,
+                      offsets=offsets, validity=validity)
+    if a.dtype.kind in (Kind.LIST, Kind.STRUCT):
+        raise TypeError("nested join keys are not supported")
+    return Column(dtype=a.dtype, length=n,
+                  data=jnp.concatenate([a.data, b.data]), validity=validity)
+
+
+@partial(jax.jit, static_argnames=("n_ops",))
+def _union_ranks(operands, *, n_ops: int) -> jnp.ndarray:
+    """Dense rank per row: equal operand tuples ⇔ equal rank."""
+    n = operands[0].shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    out = jax.lax.sort([*operands, iota], num_keys=n_ops, is_stable=True)
+    sorted_ops, order = out[:-1], out[-1]
+    neq = jnp.zeros((n,), bool)
+    for o in sorted_ops:
+        neq = neq | (o != jnp.roll(o, 1))
+    gid = jnp.cumsum(neq.at[0].set(False).astype(jnp.int32))
+    # scatter back to original row order
+    ranks = jnp.zeros((n,), jnp.int32).at[order].set(gid)
+    return ranks
+
+
+@jax.jit
+def _match_spans(lrank, lvalid, rrank, rvalid):
+    """Per-left-row [lo, hi) span of matching rows in the rank-sorted right
+    side, plus that sorted right order. Invalid (null-key) rows never match."""
+    nr = rrank.shape[0]
+    # push null-key right rows to the end and shrink the searched span
+    big = jnp.int32(2**31 - 1)
+    rkey = jnp.where(rvalid, rrank, big)
+    rorder = jnp.argsort(rkey, stable=True).astype(jnp.int32)
+    rsorted = jnp.take(rkey, rorder, axis=0)
+    n_valid = jnp.sum(rvalid.astype(jnp.int32))
+    lo = jnp.searchsorted(rsorted, lrank, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(rsorted, lrank, side="right").astype(jnp.int32)
+    hi = jnp.minimum(hi, n_valid)
+    lo = jnp.minimum(lo, hi)
+    counts = jnp.where(lvalid, hi - lo, 0)
+    return counts, lo, rorder
+
+
+@partial(jax.jit, static_argnames=("total", "outer"))
+def _expand(counts, lo, rorder, *, total: int, outer: bool):
+    nl = counts.shape[0]
+    eff = jnp.maximum(counts, 1) if outer else counts
+    starts = jnp.cumsum(eff) - eff            # exclusive scan
+    ends = starts + eff
+    j = jnp.arange(total, dtype=jnp.int32)
+    # which left row produced output slot j
+    lsel = jnp.searchsorted(ends, j, side="right").astype(jnp.int32)
+    k = j - jnp.take(starts, lsel, axis=0)
+    matched = jnp.take(counts, lsel, axis=0) > 0
+    if rorder.shape[0] == 0:                  # static shape: empty right side
+        rmap = jnp.full((total,), -1, jnp.int32)
+    else:
+        rpos = jnp.take(lo, lsel, axis=0) + k
+        rmap = jnp.take(rorder, jnp.clip(rpos, 0, rorder.shape[0] - 1), axis=0)
+        rmap = jnp.where(matched, rmap, -1) if outer else rmap
+    return lsel, rmap
+
+
+def _prep(left_keys, right_keys, null_equal: bool):
+    lcols, rcols = list(left_keys), list(right_keys)
+    if len(lcols) != len(rcols) or not lcols:
+        raise ValueError("join requires equal, nonzero key column counts")
+    union_ops: List[jnp.ndarray] = []
+    for a, b in zip(lcols, rcols):
+        # operands are built on the CONCATENATED keys: for strings the
+        # operand count depends on the padded width, so building them on the
+        # union guarantees both sides agree on the encoding
+        u = _concat_columns(a, b)
+        union_ops.extend(_key_operands(u, True, None))
+    nl = lcols[0].length
+    ranks = _union_ranks(tuple(union_ops), n_ops=len(union_ops))
+    lrank, rrank = ranks[:nl], ranks[nl:]
+
+    def side_valid(cols, n):
+        v = jnp.ones((n,), bool)
+        any_mask = False
+        for c in cols:
+            if c.validity is not None:
+                v = v & c.validity
+                any_mask = True
+        return v if (any_mask and not null_equal) else jnp.ones((n,), bool)
+
+    lvalid = side_valid(lcols, nl)
+    rvalid = side_valid(rcols, rcols[0].length)
+    return lrank, lvalid, rrank, rvalid
+
+
+def _cols(keys) -> Sequence[Column]:
+    if isinstance(keys, Column):
+        return [keys]
+    if isinstance(keys, Table):
+        return list(keys.columns)
+    return list(keys)
+
+
+def inner_join(left_keys, right_keys,
+               null_equal: bool = False) -> Tuple[Column, Column]:
+    """Gather maps (left_map, right_map) of the inner equi-join."""
+    lrank, lvalid, rrank, rvalid = _prep(_cols(left_keys), _cols(right_keys),
+                                         null_equal)
+    counts, lo, rorder = _match_spans(lrank, lvalid, rrank, rvalid)
+    total = int(jnp.sum(counts))              # the one host sync
+    lmap, rmap = _expand(counts, lo, rorder, total=total, outer=False)
+    return (Column(dtype=dtypes.INT32, length=total, data=lmap),
+            Column(dtype=dtypes.INT32, length=total, data=rmap))
+
+
+def left_join(left_keys, right_keys,
+              null_equal: bool = False) -> Tuple[Column, Column]:
+    """Left outer join: every left row appears; non-matches get right -1
+    (take() nullifies)."""
+    lrank, lvalid, rrank, rvalid = _prep(_cols(left_keys), _cols(right_keys),
+                                         null_equal)
+    counts, lo, rorder = _match_spans(lrank, lvalid, rrank, rvalid)
+    total = int(jnp.sum(jnp.maximum(counts, 1)))
+    lmap, rmap = _expand(counts, lo, rorder, total=total, outer=True)
+    return (Column(dtype=dtypes.INT32, length=total, data=lmap),
+            Column(dtype=dtypes.INT32, length=total, data=rmap))
+
+
+def left_semi_join(left_keys, right_keys,
+                   null_equal: bool = False) -> Column:
+    """Left rows having >=1 match (gather map into the left table)."""
+    lrank, lvalid, rrank, rvalid = _prep(_cols(left_keys), _cols(right_keys),
+                                         null_equal)
+    counts, _, _ = _match_spans(lrank, lvalid, rrank, rvalid)
+    keep = jnp.nonzero(counts > 0)[0].astype(jnp.int32)
+    return Column(dtype=dtypes.INT32, length=int(keep.shape[0]), data=keep)
+
+
+def left_anti_join(left_keys, right_keys,
+                   null_equal: bool = False) -> Column:
+    """Left rows having no match — Spark NOT IN/anti join. NB: rows with a
+    null key have no match, so they ARE returned (cudf behavior; Spark's
+    NOT IN null semantics are built on top by the plugin)."""
+    lrank, lvalid, rrank, rvalid = _prep(_cols(left_keys), _cols(right_keys),
+                                         null_equal)
+    counts, _, _ = _match_spans(lrank, lvalid, rrank, rvalid)
+    keep = jnp.nonzero(counts == 0)[0].astype(jnp.int32)
+    return Column(dtype=dtypes.INT32, length=int(keep.shape[0]), data=keep)

@@ -1,0 +1,167 @@
+"""Fault injector tests (reference: faultinj tool, src/main/cpp/faultinj/;
+config schema faultinj/README.md:61-170, sample config
+src/test/cpp/faultinj/test_faultinj.json)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import spark_rapids_tpu  # noqa: F401  (x64 mode)
+from spark_rapids_tpu import Column, dtypes, faultinj
+from spark_rapids_tpu.faultinj import (DeviceAssertError, DeviceFatalError,
+                                       InjectedReturnCode)
+
+
+def _col(n=8):
+    return Column.from_numpy(np.arange(n, dtype=np.int64))
+
+
+def _write(tmp_path, cfg, name="faultinj.json"):
+    p = tmp_path / name
+    p.write_text(json.dumps(cfg))
+    return str(p)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    faultinj.uninstall()
+
+
+def _ops():
+    from spark_rapids_tpu import ops
+    return ops
+
+
+def test_exact_name_match_fires_only_that_op(tmp_path):
+    path = _write(tmp_path, {"computeFaults": {
+        "murmur_hash3_32": {"percent": 100, "injectionType": 1}}})
+    faultinj.install(path)
+    ops = _ops()
+    with pytest.raises(DeviceAssertError):
+        ops.murmur_hash3_32(_col())
+    # a different op is untouched
+    out = ops.xxhash64(_col())
+    assert out.length == 8
+
+
+def test_wildcard_matches_every_op(tmp_path):
+    path = _write(tmp_path, {"computeFaults": {
+        "*": {"percent": 100, "injectionType": 1}}})
+    faultinj.install(path)
+    ops = _ops()
+    for fn in (lambda: ops.murmur_hash3_32(_col()),
+               lambda: ops.xxhash64(_col()),
+               lambda: ops.interleave_bits([_col()])):
+        with pytest.raises(DeviceAssertError):
+            fn()
+
+
+def test_interception_count_limits_eligibility(tmp_path):
+    path = _write(tmp_path, {"computeFaults": {
+        "xxhash64": {"percent": 100, "injectionType": 1,
+                     "interceptionCount": 2}}})
+    faultinj.install(path)
+    ops = _ops()
+    for _ in range(2):
+        with pytest.raises(DeviceAssertError):
+            ops.xxhash64(_col())
+    # eligibility exhausted: call goes through
+    assert ops.xxhash64(_col()).length == 8
+
+
+def test_percent_zero_never_fires(tmp_path):
+    path = _write(tmp_path, {"computeFaults": {
+        "*": {"percent": 0, "injectionType": 1}}})
+    faultinj.install(path)
+    ops = _ops()
+    for _ in range(10):
+        assert ops.xxhash64(_col()).length == 8
+
+
+def test_substitute_return_code(tmp_path):
+    path = _write(tmp_path, {"computeFaults": {
+        "xxhash64": {"percent": 100, "injectionType": 2,
+                     "substituteReturnCode": 999}}})
+    faultinj.install(path)
+    with pytest.raises(InjectedReturnCode) as ei:
+        _ops().xxhash64(_col())
+    assert ei.value.code == 999
+
+
+def test_fatal_poisons_device_until_reset(tmp_path):
+    path = _write(tmp_path, {"computeFaults": {
+        "murmur_hash3_32": {"percent": 100, "injectionType": 0,
+                            "interceptionCount": 1}}})
+    inj = faultinj.install(path)
+    ops = _ops()
+    with pytest.raises(DeviceFatalError):
+        ops.murmur_hash3_32(_col())
+    assert inj.device_poisoned
+    # every later device call fails, even ones with no matching rule:
+    # fatal faults leave the device unusable (faultinj/README.md:6-10)
+    with pytest.raises(DeviceFatalError):
+        ops.xxhash64(_col())
+    inj.reset_device()
+    assert ops.xxhash64(_col()).length == 8
+
+
+def test_runtime_faults_hit_memory_calls(tmp_path):
+    from spark_rapids_tpu.runtime import DeviceSession
+    path = _write(tmp_path, {"runtimeFaults": {
+        "MemoryBudget.acquire": {"percent": 100, "injectionType": 1}}})
+    faultinj.install(path)
+    with DeviceSession(device_limit_bytes=1 << 20, watchdog=False) as s:
+        s.arbiter.current_thread_is_dedicated_to_task(1)
+        try:
+            with pytest.raises(DeviceAssertError):
+                s.device.acquire(1024)
+        finally:
+            s.arbiter.task_done(1)
+
+
+def test_dynamic_hot_reload(tmp_path):
+    path = _write(tmp_path, {"dynamic": True, "computeFaults": {
+        "xxhash64": {"percent": 0, "injectionType": 1}}})
+    faultinj.install(path)
+    ops = _ops()
+    assert ops.xxhash64(_col()).length == 8   # percent 0: passes
+    # flip the config on disk (interactive "dynamic" mode, README.md:86-88)
+    with open(path, "w") as f:
+        json.dump({"dynamic": True, "computeFaults": {
+            "xxhash64": {"percent": 100, "injectionType": 1}}}, f)
+    os.utime(path, (0, 12345))                # force an mtime change
+    with pytest.raises(DeviceAssertError):
+        ops.xxhash64(_col())
+
+
+def test_uninstall_restores_clean_calls(tmp_path):
+    path = _write(tmp_path, {"computeFaults": {
+        "*": {"percent": 100, "injectionType": 1}}})
+    faultinj.install(path)
+    ops = _ops()
+    with pytest.raises(DeviceAssertError):
+        ops.xxhash64(_col())
+    faultinj.uninstall()
+    assert ops.xxhash64(_col()).length == 8
+
+
+def test_seed_reproducible_sampling(tmp_path):
+    cfg = {"seed": 42, "computeFaults": {
+        "xxhash64": {"percent": 50, "injectionType": 1}}}
+    outcomes = []
+    for _ in range(2):
+        faultinj.install(_write(tmp_path, cfg))
+        ops = _ops()
+        row = []
+        for _ in range(12):
+            try:
+                ops.xxhash64(_col())
+                row.append(False)
+            except DeviceAssertError:
+                row.append(True)
+        outcomes.append(row)
+        faultinj.uninstall()
+    assert outcomes[0] == outcomes[1]
+    assert any(outcomes[0]) and not all(outcomes[0])

@@ -1,0 +1,279 @@
+"""sort / gather / groupby / join tests (BASELINE.json configs[0-2]; oracle =
+numpy/pandas, the way the reference's JUnit tests oracle against BigDecimal /
+java.time — SURVEY.md §4 tier 2)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_rapids_tpu  # noqa: F401
+from spark_rapids_tpu import Column, Table, dtypes
+from spark_rapids_tpu.ops import (groupby_aggregate, inner_join,
+                                  left_anti_join, left_join, left_semi_join,
+                                  sort_table, sorted_order, take)
+
+
+def col(values, dtype=None, nulls=None):
+    arr = np.asarray(values, dtype=dtype)
+    c = Column.from_numpy(arr)
+    if nulls is not None:
+        import jax.numpy as jnp
+        c = c.with_validity(jnp.asarray(~np.asarray(nulls)))
+    return c
+
+
+def scol(values):
+    return Column.from_pylist(values, dtypes.STRING)
+
+
+# ---- take -------------------------------------------------------------------
+
+def test_take_fixed_and_null_index():
+    c = col([10, 20, 30, 40], np.int64, nulls=[False, True, False, False])
+    out = take(c, np.array([3, 1, 0, -1], np.int32))
+    assert out.to_pylist() == [40, None, 10, None]
+
+
+def test_take_strings():
+    c = scol(["aa", None, "cccc", ""])
+    out = take(c, np.array([2, 0, -1, 3, 1], np.int32))
+    assert out.to_pylist() == ["cccc", "aa", None, "", None]
+
+
+def test_take_decimal128():
+    from spark_rapids_tpu.ops import string_to_decimal
+    c = string_to_decimal(scol(["1.23", "-99999999999999999999.99", "0.01"]),
+                          precision=38, scale=2)
+    out = take(c, np.array([2, 0], np.int32))
+    assert out.to_pylist() == [1, 123]    # unscaled values at scale 2
+
+
+# ---- sort -------------------------------------------------------------------
+
+def test_sorted_order_ints_stable():
+    c = col([3, 1, 2, 1, 3], np.int64)
+    order = np.asarray(sorted_order([c]).data)
+    assert order.tolist() == [1, 3, 2, 0, 4]
+
+
+def test_sort_multi_key_mixed_direction():
+    a = col([1, 1, 2, 2, 1], np.int32)
+    b = col([5.0, 7.0, 1.0, 3.0, 6.0], np.float64)
+    t = Table([a, b], names=["a", "b"])
+    out = sort_table(t, ["a", "b"], ascending=[True, False])
+    assert out["a"].to_pylist() == [1, 1, 1, 2, 2]
+    assert out["b"].to_pylist() == [7.0, 6.0, 5.0, 3.0, 1.0]
+
+
+def test_sort_nulls_first_last():
+    c = col([2, 0, 1, 0], np.int64, nulls=[False, True, False, True])
+    asc = sort_table(Table([c]), [0]).columns[0].to_pylist()
+    assert asc == [None, None, 1, 2]            # Spark asc: nulls first
+    desc = sort_table(Table([c]), [0], ascending=False).columns[0].to_pylist()
+    assert desc == [2, 1, None, None]           # Spark desc: nulls last
+
+
+def test_sort_float_nan_and_negzero():
+    c = col([np.nan, 1.0, -np.inf, -0.0, 0.0, np.inf], np.float64)
+    out = sort_table(Table([c]), [0]).columns[0].to_pylist()
+    assert np.isnan(out[-1])                    # NaN greatest, like Spark
+    assert out[:5] == [-np.inf, 0.0, 0.0, 1.0, np.inf]
+
+
+def test_sort_strings_bytewise():
+    c = scol(["b", "", "ab", "a", "a\x00", "ba", None])
+    out = sort_table(Table([c]), [0]).columns[0].to_pylist()
+    assert out == [None, "", "a", "a\x00", "ab", "b", "ba"]
+
+
+def test_sort_random_against_numpy():
+    rng = np.random.default_rng(0)
+    vals = rng.integers(-1000, 1000, size=4096).astype(np.int64)
+    out = sort_table(Table([col(vals)]), [0]).columns[0].to_pylist()
+    assert out == sorted(vals.tolist())
+
+
+# ---- groupby ----------------------------------------------------------------
+
+def test_groupby_sum_count_basic():
+    k = col([1, 2, 1, 2, 1], np.int32)
+    v = col([10, 20, 30, 40, 50], np.int64)
+    t = Table([k, v], names=["k", "v"])
+    out = groupby_aggregate(t, ["k"], [("v", "sum"), ("v", "count"),
+                                       ("v", "size")])
+    assert out["k"].to_pylist() == [1, 2]
+    assert out["sum(v)"].to_pylist() == [90, 60]
+    assert out["count(v)"].to_pylist() == [3, 2]
+    assert out["size(*)"].to_pylist() == [3, 2]
+
+
+def test_groupby_nulls_in_keys_and_values():
+    k = col([1, 1, 0, 2], np.int32, nulls=[False, False, True, False])
+    v = col([5, 0, 7, 9], np.int64, nulls=[False, True, False, False])
+    t = Table([k, v], names=["k", "v"])
+    out = groupby_aggregate(t, ["k"], [("v", "sum"), ("v", "count")])
+    # null key is its own group, sorted first
+    assert out["k"].to_pylist() == [None, 1, 2]
+    assert out["sum(v)"].to_pylist() == [7, 5, 9]
+    assert out["count(v)"].to_pylist() == [1, 1, 1]
+
+
+def test_groupby_all_null_group_yields_null_agg():
+    k = col([1, 1, 2], np.int32)
+    v = col([0, 0, 3], np.int64, nulls=[True, True, False])
+    out = groupby_aggregate(Table([k, v], names=["k", "v"]), ["k"],
+                            [("v", "sum"), ("v", "min"), ("v", "max"),
+                             ("v", "mean")])
+    assert out["sum(v)"].to_pylist() == [None, 3]
+    assert out["min(v)"].to_pylist() == [None, 3]
+    assert out["max(v)"].to_pylist() == [None, 3]
+    assert out["mean(v)"].to_pylist() == [None, 3.0]
+
+
+def test_groupby_string_keys():
+    k = scol(["x", "y", "x", None, "y", "x"])
+    v = col([1, 2, 3, 4, 5, 6], np.int64)
+    out = groupby_aggregate(Table([k, v], names=["k", "v"]), ["k"],
+                            [("v", "sum")])
+    assert out["k"].to_pylist() == [None, "x", "y"]
+    assert out["sum(v)"].to_pylist() == [4, 10, 7]
+
+
+def test_groupby_random_against_pandas():
+    rng = np.random.default_rng(1)
+    n = 20_000
+    k1 = rng.integers(0, 97, size=n).astype(np.int32)
+    k2 = rng.integers(0, 5, size=n).astype(np.int64)
+    v = rng.integers(-10**6, 10**6, size=n).astype(np.int64)
+    f = rng.standard_normal(n)
+    t = Table([col(k1), col(k2), col(v), col(f)], names=["k1", "k2", "v", "f"])
+    out = groupby_aggregate(t, ["k1", "k2"],
+                            [("v", "sum"), ("v", "min"), ("f", "max"),
+                             ("v", "count"), ("f", "mean")])
+    df = pd.DataFrame({"k1": k1, "k2": k2, "v": v, "f": f})
+    ref = df.groupby(["k1", "k2"], sort=True).agg(
+        s=("v", "sum"), mn=("v", "min"), mx=("f", "max"),
+        c=("v", "count"), m=("f", "mean")).reset_index()
+    assert out["k1"].to_pylist() == ref["k1"].tolist()
+    assert out["k2"].to_pylist() == ref["k2"].tolist()
+    assert out["sum(v)"].to_pylist() == ref["s"].tolist()
+    assert out["min(v)"].to_pylist() == ref["mn"].tolist()
+    assert np.allclose(out["max(f)"].to_pylist(), ref["mx"].tolist())
+    assert out["count(v)"].to_pylist() == ref["c"].tolist()
+    assert np.allclose(out["mean(f)"].to_pylist(), ref["m"].tolist())
+
+
+def test_groupby_int_sum_wraps_like_java_long():
+    k = col([7, 7], np.int32)
+    v = col([2**63 - 1, 1], np.int64)
+    out = groupby_aggregate(Table([k, v], names=["k", "v"]), ["k"],
+                            [("v", "sum")])
+    assert out["sum(v)"].to_pylist() == [-(2**63)]   # wraps, non-ANSI Spark
+
+
+# ---- joins ------------------------------------------------------------------
+
+def test_inner_join_basic_with_dups():
+    lk = col([1, 2, 3, 2], np.int64)
+    rk = col([2, 4, 2, 1], np.int64)
+    lmap, rmap = inner_join([lk], [rk])
+    pairs = sorted(zip(lmap.to_pylist(), rmap.to_pylist()))
+    assert pairs == [(0, 3), (1, 0), (1, 2), (3, 0), (3, 2)]
+
+
+def test_inner_join_nulls_never_match():
+    lk = col([1, 0, 2], np.int64, nulls=[False, True, False])
+    rk = col([0, 2], np.int64, nulls=[True, False])
+    lmap, rmap = inner_join([lk], [rk])
+    assert sorted(zip(lmap.to_pylist(), rmap.to_pylist())) == [(2, 1)]
+    # null-safe equality (<=>) matches nulls
+    lmap2, rmap2 = inner_join([lk], [rk], null_equal=True)
+    assert sorted(zip(lmap2.to_pylist(), rmap2.to_pylist())) == [(1, 0), (2, 1)]
+
+
+def test_left_join_unmatched_gets_null():
+    lk = col([5, 6], np.int64)
+    rk = col([6], np.int64)
+    rv = scol(["hit"])
+    lmap, rmap = left_join([lk], [rk])
+    got = sorted(zip(lmap.to_pylist(), rmap.to_pylist()))
+    assert got == [(0, -1), (1, 0)]
+    joined = take(rv, rmap.data)
+    by_left = dict(zip(lmap.to_pylist(), joined.to_pylist()))
+    assert by_left == {0: None, 1: "hit"}
+
+
+def test_semi_and_anti_join():
+    lk = col([1, 2, 3, 0], np.int64, nulls=[False, False, False, True])
+    rk = col([2, 2, 3], np.int64)
+    assert left_semi_join([lk], [rk]).to_pylist() == [1, 2]
+    assert left_anti_join([lk], [rk]).to_pylist() == [0, 3]
+
+
+def test_join_multi_key_and_strings():
+    lk1 = col([1, 1, 2], np.int32)
+    lk2 = scol(["a", "b", "a"])
+    rk1 = col([1, 2, 1], np.int32)
+    rk2 = scol(["b", "a", "z"])
+    lmap, rmap = inner_join([lk1, lk2], [rk1, rk2])
+    assert sorted(zip(lmap.to_pylist(), rmap.to_pylist())) == [(1, 0), (2, 1)]
+
+
+def test_join_empty_right():
+    lk = col([1, 2], np.int64)
+    rk = col([], np.int64)
+    lmap, rmap = inner_join([lk], [rk])
+    assert lmap.length == 0
+    lmap, rmap = left_join([lk], [rk])
+    assert sorted(zip(lmap.to_pylist(), rmap.to_pylist())) == [(0, -1), (1, -1)]
+
+
+def test_null_payload_bytes_do_not_split_groups():
+    # payload under null slots is undefined; two nulls with different
+    # underlying bytes must still be ONE group / match under <=>
+    import jax.numpy as jnp
+    k = Column.from_numpy(np.array([5, 7], np.int64)).with_validity(
+        jnp.asarray([False, False]))
+    v = col([1, 2], np.int64)
+    out = groupby_aggregate(Table([k, v], names=["k", "v"]), ["k"],
+                            [("v", "sum")])
+    assert out["k"].to_pylist() == [None]
+    assert out["sum(v)"].to_pylist() == [3]
+    lk = Column.from_numpy(np.array([5], np.int64)).with_validity(
+        jnp.asarray([False]))
+    rk = Column.from_numpy(np.array([7], np.int64)).with_validity(
+        jnp.asarray([False]))
+    lmap, rmap = inner_join([lk], [rk], null_equal=True)
+    assert list(zip(lmap.to_pylist(), rmap.to_pylist())) == [(0, 0)]
+
+
+def test_groupby_float_min_max_nan_semantics():
+    # Spark: NaN is greatest — min skips NaN unless the group is all-NaN
+    k = col([1, 1, 1, 2, 2], np.int32)
+    v = col([np.nan, 3.0, 7.0, np.nan, np.nan], np.float64)
+    out = groupby_aggregate(Table([k, v], names=["k", "v"]), ["k"],
+                            [("v", "min"), ("v", "max")])
+    mins = out["min(v)"].to_pylist()
+    maxs = out["max(v)"].to_pylist()
+    assert mins[0] == 3.0 and np.isnan(mins[1])
+    assert np.isnan(maxs[0]) and np.isnan(maxs[1])
+
+
+def test_join_rejects_mismatched_decimal_scales():
+    from spark_rapids_tpu.ops import string_to_decimal
+    a = string_to_decimal(scol(["1.00"]), precision=18, scale=2)
+    b = string_to_decimal(scol(["100"]), precision=18, scale=0)
+    with pytest.raises(TypeError):
+        inner_join([a], [b])
+
+
+def test_join_random_against_pandas():
+    rng = np.random.default_rng(3)
+    nl, nr = 5000, 1000
+    lk = rng.integers(0, 700, size=nl).astype(np.int64)
+    rk = rng.integers(0, 700, size=nr).astype(np.int64)
+    lmap, rmap = inner_join([col(lk)], [col(rk)])
+    got = sorted(zip(lmap.to_pylist(), rmap.to_pylist()))
+    dl = pd.DataFrame({"k": lk, "li": np.arange(nl)})
+    dr = pd.DataFrame({"k": rk, "ri": np.arange(nr)})
+    ref = dl.merge(dr, on="k")
+    assert got == sorted(zip(ref["li"].tolist(), ref["ri"].tolist()))
