@@ -1,0 +1,60 @@
+"""Chunked parquet read → filter → project bench — BASELINE.json configs[3]
+("chunked Parquet read → filter → project, single 1GB file"; scaled by
+--scale). Measures decode + device transfer + a filter/project pipeline."""
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from benchmarks.common import parse_args  # noqa: E402
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.io import ParquetChunkedReader
+
+    n = max(int(40_000_000 * args.scale), 65_536)   # ~1GB at scale 1
+    rng = np.random.default_rng(0)
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 10_000, n), pa.int64()),
+        "v": pa.array(rng.standard_normal(n), pa.float64()),
+        "w": pa.array(rng.integers(-10**9, 10**9, n), pa.int64()),
+    })
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "bench.parquet")
+        pq.write_table(t, path, row_group_size=1 << 20, compression="SNAPPY")
+        size_mb = os.path.getsize(path) / 1e6
+
+        @jax.jit
+        def filter_project(k, v):
+            keep = (k % 10) == 0
+            return jnp.where(keep, v * 2.0, 0.0).sum()
+
+        t0 = time.perf_counter()
+        total = 0.0
+        rows = 0
+        with ParquetChunkedReader(path, columns=["k", "v"]) as r:
+            while r.has_next():
+                chunk = r.read_chunk()
+                total += float(filter_project(chunk["k"].data,
+                                              chunk["v"].data))
+                rows += chunk.num_rows
+        dt = time.perf_counter() - t0
+        print(json.dumps({"bench": "parquet_read_filter_project",
+                          "axes": {"num_rows": rows,
+                                   "file_mb": round(size_mb, 1)},
+                          "ms": round(dt * 1e3, 1),
+                          "rows_per_s": round(rows / dt)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

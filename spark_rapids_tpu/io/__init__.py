@@ -1,5 +1,6 @@
 from .parquet_footer import (ParquetFooter, StructElement, ListElement,
                              MapElement, ValueElement)
+from .parquet import ParquetChunkedReader, read_parquet
 
 __all__ = ["ParquetFooter", "StructElement", "ListElement", "MapElement",
-           "ValueElement"]
+           "ValueElement", "ParquetChunkedReader", "read_parquet"]

@@ -16,6 +16,13 @@ _LOCK = threading.Lock()
 _SOURCES = {
     "resource_adaptor": ["resource_adaptor.cpp"],
     "parquet_footer": ["parquet_footer.cpp"],
+    "parquet_reader": ["parquet_reader.cpp"],
+}
+
+# extra link flags per lib (page decompression codecs; libsnappy ships no
+# dev symlink in this image, hence the -l: literal form)
+_LDFLAGS = {
+    "parquet_reader": ["-lz", "-lzstd", "-l:libsnappy.so.1"],
 }
 
 
@@ -32,7 +39,7 @@ def build(name: str) -> str:
                 os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs):
             return out
         cmd = ["g++", "-std=c++17", "-O2", "-g", "-fPIC", "-shared", "-pthread",
-               "-Wall", "-Wextra", "-o", out] + srcs
+               "-Wall", "-Wextra", "-o", out] + srcs + _LDFLAGS.get(name, [])
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(

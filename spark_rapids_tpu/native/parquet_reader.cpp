@@ -1,0 +1,850 @@
+// Chunked parquet column-chunk reader (host-only C++).
+//
+// TPU-native counterpart of the cudf chunked parquet reader the reference
+// jar re-exports (SURVEY.md §2.1 #17 feeds the filtered footer to "the cudf
+// chunked parquet reader"; BASELINE.json configs[3] "chunked Parquet read →
+// filter → project"). The GPU stack decodes pages with CUDA kernels; pages
+// are a bitstream format (thrift headers, RLE/bit-packed hybrid levels,
+// dictionary indices) that a TPU cannot branch through efficiently, so the
+// decode hot path lives here as native host code and hands the TPU dense
+// Arrow-layout buffers (values + validity + offsets) ready for device_put.
+//
+// Scope: flat (non-nested) schemas; PLAIN / RLE / PLAIN_DICTIONARY /
+// RLE_DICTIONARY encodings; DataPage v1+v2; UNCOMPRESSED / SNAPPY / GZIP /
+// ZSTD codecs. Physical types BOOLEAN, INT32, INT64, INT96, FLOAT, DOUBLE,
+// BYTE_ARRAY, FIXED_LEN_BYTE_ARRAY.
+//
+// C ABI (ctypes): pqr_open / pqr_* accessors / pqr_read_column / pqr_free.
+// Two-phase reads: call with null outputs to size, then with buffers.
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include <zlib.h>
+#include <zstd.h>
+
+// libsnappy.so.1 ships no header in this image; declaring the exact C++
+// signatures reproduces the mangled symbols.
+namespace snappy {
+bool RawUncompress(const char* compressed, size_t compressed_length,
+                   char* uncompressed);
+bool GetUncompressedLength(const char* start, size_t n, size_t* result);
+}  // namespace snappy
+
+namespace {
+
+// ---- thrift compact protocol reader (subset) --------------------------------
+
+struct TReader {
+  uint8_t const* p;
+  uint8_t const* end;
+
+  uint8_t u8() {
+    if (p >= end) throw std::runtime_error("thrift: eof");
+    return *p++;
+  }
+  uint64_t uvarint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (true) {
+      uint8_t b = u8();
+      v |= uint64_t(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+      if (shift > 63) throw std::runtime_error("thrift: varint overflow");
+    }
+  }
+  int64_t zigzag() {
+    uint64_t u = uvarint();
+    return int64_t(u >> 1) ^ -int64_t(u & 1);
+  }
+  std::string binary() {
+    uint64_t n = uvarint();
+    if (uint64_t(end - p) < n) throw std::runtime_error("thrift: bad binary");
+    std::string s(reinterpret_cast<char const*>(p), n);
+    p += n;
+    return s;
+  }
+  void skip(uint8_t type);
+  void skip_struct() {
+    int16_t fid = 0;
+    while (true) {
+      uint8_t b = u8();
+      if (b == 0) return;
+      uint8_t type = b & 0x0f;
+      int16_t delta = (b >> 4) & 0x0f;
+      fid = delta ? int16_t(fid + delta) : int16_t(zigzag());
+      (void)fid;
+      skip(type);
+    }
+  }
+};
+
+void TReader::skip(uint8_t type) {
+  switch (type) {
+    case 1:
+    case 2: break;                        // bool true/false in field header
+    case 3: u8(); break;                  // i8
+    case 4:
+    case 5:
+    case 6: zigzag(); break;              // i16/i32/i64
+    case 7: p += 8; break;                // double
+    case 8: binary(); break;              // binary/string
+    case 9: {                             // list
+      uint8_t b = u8();
+      uint64_t n = (b >> 4) & 0x0f;
+      uint8_t et = b & 0x0f;
+      if (n == 15) n = uvarint();
+      for (uint64_t i = 0; i < n; i++) skip(et);
+      break;
+    }
+    case 12: skip_struct(); break;        // struct
+    default: throw std::runtime_error("thrift: unsupported type to skip");
+  }
+}
+
+// iterate a struct's fields: cb(field_id, type, reader) returns true if it
+// consumed the value, false to skip
+template <typename F>
+void read_struct(TReader& r, F&& cb) {
+  int16_t fid = 0;
+  while (true) {
+    uint8_t b = r.u8();
+    if (b == 0) return;
+    uint8_t type = b & 0x0f;
+    int16_t delta = (b >> 4) & 0x0f;
+    fid = delta ? int16_t(fid + delta) : int16_t(r.zigzag());
+    if (!cb(fid, type, r)) r.skip(type);
+  }
+}
+
+template <typename F>
+void read_list(TReader& r, F&& cb) {
+  uint8_t b = r.u8();
+  uint64_t n = (b >> 4) & 0x0f;
+  uint8_t et = b & 0x0f;
+  if (n == 15) n = r.uvarint();
+  for (uint64_t i = 0; i < n; i++) cb(et, r);
+}
+
+// ---- parquet metadata model -------------------------------------------------
+
+enum PhysType : int32_t {
+  PT_BOOLEAN = 0, PT_INT32 = 1, PT_INT64 = 2, PT_INT96 = 3, PT_FLOAT = 4,
+  PT_DOUBLE = 5, PT_BYTE_ARRAY = 6, PT_FLBA = 7,
+};
+
+struct LeafSchema {
+  std::string name;       // dotted path for nested, plain name for flat
+  int32_t phys_type = -1;
+  int32_t type_length = 0;
+  int32_t converted = -1;   // ConvertedType enum (UTF8=0, DATE=6, ...)
+  int32_t scale = 0, precision = 0;
+  bool optional = false;
+  bool flat = true;         // false if nested under a group (unsupported)
+};
+
+struct ChunkMeta {
+  int32_t schema_idx = -1;  // into leaves
+  int32_t codec = 0;
+  int64_t num_values = 0;
+  int64_t data_page_offset = -1;
+  int64_t dict_page_offset = -1;
+  int64_t total_compressed_size = 0;
+};
+
+struct RowGroup {
+  int64_t num_rows = 0;
+  std::vector<ChunkMeta> chunks;
+};
+
+struct DecodedChunk;
+
+struct FileState {
+  // non-owning view by default (zero-copy: Python keeps the mmap/bytes
+  // alive for the handle's lifetime); `owned` is used by the copying open
+  std::vector<uint8_t> owned;
+  uint8_t const* data_ptr = nullptr;
+  size_t data_len = 0;
+  std::vector<LeafSchema> leaves;
+  std::vector<RowGroup> groups;
+  int64_t num_rows = 0;
+  // sizing-phase decode results, consumed by the fill phase so each chunk
+  // is decompressed+decoded exactly once
+  std::map<std::pair<int32_t, int32_t>, std::shared_ptr<DecodedChunk>> cache;
+  std::mutex cache_mu;
+};
+
+thread_local std::string g_error;
+
+void parse_schema(TReader& r, std::vector<LeafSchema>& leaves) {
+  // list<SchemaElement>; element 0 is the root group
+  struct Elem {
+    LeafSchema leaf;
+    int32_t num_children = 0;
+    int32_t repetition = 0;
+    bool is_group = false;
+  };
+  std::vector<Elem> elems;
+  read_list(r, [&](uint8_t, TReader& rr) {
+    Elem e;
+    bool has_type = false;
+    read_struct(rr, [&](int16_t fid, uint8_t type, TReader& r3) {
+      switch (fid) {
+        case 1: e.leaf.phys_type = int32_t(r3.zigzag()); has_type = true; return true;
+        case 2: e.leaf.type_length = int32_t(r3.zigzag()); return true;
+        case 3: e.repetition = int32_t(r3.zigzag()); return true;
+        case 4: e.leaf.name = r3.binary(); return true;
+        case 5: e.num_children = int32_t(r3.zigzag()); return true;
+        case 6: e.leaf.converted = int32_t(r3.zigzag()); return true;
+        case 7: e.leaf.scale = int32_t(r3.zigzag()); return true;
+        case 8: e.leaf.precision = int32_t(r3.zigzag()); return true;
+        default: (void)type; return false;
+      }
+    });
+    e.is_group = !has_type;
+    elems.push_back(std::move(e));
+  });
+  if (elems.empty()) throw std::runtime_error("parquet: empty schema");
+  // walk the tree depth-first to find leaves + whether they sit at depth 1
+  size_t pos = 1;
+  struct Frame { int32_t remaining; int depth; };
+  std::vector<Frame> stack{{elems[0].num_children, 0}};
+  while (pos < elems.size() && !stack.empty()) {
+    while (!stack.empty() && stack.back().remaining == 0) stack.pop_back();
+    if (stack.empty()) break;
+    stack.back().remaining--;
+    Elem& e = elems[pos++];
+    int depth = int(stack.size());
+    if (e.is_group) {
+      stack.push_back({e.num_children, depth});
+    } else {
+      LeafSchema leaf = e.leaf;
+      leaf.optional = e.repetition == 1;   // 0 required, 1 optional, 2 repeated
+      leaf.flat = depth == 1 && e.repetition != 2;
+      leaves.push_back(std::move(leaf));
+    }
+  }
+}
+
+void parse_footer(FileState& st) {
+  uint8_t const* d = st.data_ptr;
+  size_t sz = st.data_len;
+  if (sz < 12 || std::memcmp(d + sz - 4, "PAR1", 4) != 0)
+    throw std::runtime_error("parquet: bad magic");
+  uint32_t flen;
+  std::memcpy(&flen, d + sz - 8, 4);
+  if (flen + 12ull > sz)
+    throw std::runtime_error("parquet: footer length out of range");
+  TReader r{d + sz - 8 - flen, d + sz - 8};
+
+  read_struct(r, [&](int16_t fid, uint8_t type, TReader& rr) {
+    if (fid == 2 && type == 9) {          // schema
+      parse_schema(rr, st.leaves);
+      return true;
+    }
+    if (fid == 3) { st.num_rows = rr.zigzag(); return true; }
+    if (fid == 4 && type == 9) {          // row_groups
+      read_list(rr, [&](uint8_t, TReader& r2) {
+        RowGroup rg;
+        read_struct(r2, [&](int16_t f2, uint8_t t2, TReader& r3) {
+          if (f2 == 1 && t2 == 9) {       // columns: list<ColumnChunk>
+            read_list(r3, [&](uint8_t, TReader& r4) {
+              ChunkMeta cm;
+              read_struct(r4, [&](int16_t f4, uint8_t t4, TReader& r5) {
+                if (f4 == 3 && t4 == 12) {  // meta_data: ColumnMetaData
+                  std::string path;
+                  read_struct(r5, [&](int16_t f5, uint8_t t5, TReader& r6) {
+                    switch (f5) {
+                      case 3:  // path_in_schema: list<string>
+                        if (t5 == 9) {
+                          read_list(r6, [&](uint8_t, TReader& r7) {
+                            if (!path.empty()) path += '.';
+                            path += r7.binary();
+                          });
+                          return true;
+                        }
+                        return false;
+                      case 4: cm.codec = int32_t(r6.zigzag()); return true;
+                      case 5: cm.num_values = r6.zigzag(); return true;
+                      case 7: cm.total_compressed_size = r6.zigzag(); return true;
+                      case 9: cm.data_page_offset = r6.zigzag(); return true;
+                      case 11: cm.dict_page_offset = r6.zigzag(); return true;
+                      default: return false;
+                    }
+                  });
+                  // match path to a leaf
+                  for (size_t i = 0; i < st.leaves.size(); i++) {
+                    if (st.leaves[i].name == path) {
+                      cm.schema_idx = int32_t(i);
+                      break;
+                    }
+                  }
+                  return true;
+                }
+                return false;
+              });
+              rg.chunks.push_back(cm);
+            });
+            return true;
+          }
+          if (f2 == 3) { rg.num_rows = r3.zigzag(); return true; }
+          return false;
+        });
+        st.groups.push_back(std::move(rg));
+      });
+      return true;
+    }
+    return false;
+  });
+}
+
+// ---- page decode ------------------------------------------------------------
+
+enum Codec : int32_t {
+  C_UNCOMPRESSED = 0, C_SNAPPY = 1, C_GZIP = 2, C_ZSTD = 6,
+};
+
+std::vector<uint8_t> decompress(int32_t codec, uint8_t const* in, size_t n,
+                                size_t out_size) {
+  std::vector<uint8_t> out(out_size);
+  switch (codec) {
+    case C_UNCOMPRESSED:
+      if (n != out_size) throw std::runtime_error("parquet: size mismatch");
+      std::memcpy(out.data(), in, n);
+      return out;
+    case C_SNAPPY: {
+      size_t len = 0;
+      if (!snappy::GetUncompressedLength(reinterpret_cast<char const*>(in), n,
+                                         &len) ||
+          len != out_size ||
+          !snappy::RawUncompress(reinterpret_cast<char const*>(in), n,
+                                 reinterpret_cast<char*>(out.data())))
+        throw std::runtime_error("parquet: snappy decode failed");
+      return out;
+    }
+    case C_GZIP: {
+      z_stream zs{};
+      if (inflateInit2(&zs, 15 + 32) != Z_OK)  // zlib or gzip stream
+        throw std::runtime_error("parquet: zlib init failed");
+      zs.next_in = const_cast<Bytef*>(in);
+      zs.avail_in = uInt(n);
+      zs.next_out = out.data();
+      zs.avail_out = uInt(out_size);
+      int rc = inflate(&zs, Z_FINISH);
+      inflateEnd(&zs);
+      if (rc != Z_STREAM_END || zs.total_out != out_size)
+        throw std::runtime_error("parquet: gzip decode failed");
+      return out;
+    }
+    case C_ZSTD: {
+      size_t rc = ZSTD_decompress(out.data(), out_size, in, n);
+      if (ZSTD_isError(rc) || rc != out_size)
+        throw std::runtime_error("parquet: zstd decode failed");
+      return out;
+    }
+    default:
+      throw std::runtime_error("parquet: unsupported codec " +
+                               std::to_string(codec));
+  }
+}
+
+// RLE / bit-packed hybrid (parquet format §RLE). Decodes `count` values of
+// `bit_width` into out.
+void rle_decode(uint8_t const* p, uint8_t const* end, int bit_width,
+                int64_t count, int32_t* out) {
+  if (bit_width < 0 || bit_width > 32)   // file-supplied: must be validated
+    throw std::runtime_error("parquet: bad RLE bit width " +
+                             std::to_string(bit_width));
+  if (bit_width == 0) {
+    std::fill(out, out + count, 0);
+    return;
+  }
+  int byte_width = (bit_width + 7) / 8;
+  int64_t got = 0;
+  while (got < count) {
+    if (p >= end) throw std::runtime_error("parquet: rle eof");
+    uint64_t header = 0;
+    int shift = 0;
+    while (true) {
+      if (p >= end) throw std::runtime_error("parquet: rle eof");
+      uint8_t b = *p++;
+      header |= uint64_t(b & 0x7f) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+    }
+    if (header & 1) {                       // bit-packed run
+      int64_t groups = int64_t(header >> 1);
+      int64_t nvals = groups * 8;
+      int64_t nbytes = groups * bit_width;
+      if (end - p < nbytes) throw std::runtime_error("parquet: rle eof");
+      int64_t take = std::min(nvals, count - got);
+      uint64_t mask = (bit_width == 32) ? 0xffffffffull
+                                        : ((1ull << bit_width) - 1);
+      uint64_t buf = 0;
+      int bits_in = 0;
+      uint8_t const* q = p;
+      for (int64_t i = 0; i < take; i++) {
+        while (bits_in < bit_width) {
+          buf |= uint64_t(*q++) << bits_in;
+          bits_in += 8;
+        }
+        out[got + i] = int32_t(buf & mask);
+        buf >>= bit_width;
+        bits_in -= bit_width;
+      }
+      p += nbytes;
+      got += take;
+    } else {                                // rle run
+      int64_t run = int64_t(header >> 1);
+      if (end - p < byte_width) throw std::runtime_error("parquet: rle eof");
+      uint32_t v = 0;
+      std::memcpy(&v, p, byte_width);       // byte_width <= 4 (bit_width<=32)
+      p += byte_width;
+      int64_t take = std::min(run, count - got);
+      std::fill(out + got, out + got + take, int32_t(v));
+      got += take;
+    }
+  }
+}
+
+struct PageHeader {
+  int32_t type = -1;          // 0 data, 2 dictionary, 3 data_v2
+  int32_t uncompressed_size = 0;
+  int32_t compressed_size = 0;
+  // v1 data page
+  int32_t num_values = 0;
+  int32_t encoding = -1;
+  int32_t def_encoding = -1;
+  // v2
+  int32_t num_nulls = 0;
+  int32_t num_rows = 0;
+  int32_t def_len = 0, rep_len = 0;
+  bool v2_compressed = true;
+  // dictionary page
+  int32_t dict_num_values = 0;
+  int32_t dict_encoding = -1;
+};
+
+PageHeader read_page_header(TReader& r) {
+  PageHeader h;
+  read_struct(r, [&](int16_t fid, uint8_t type, TReader& rr) {
+    switch (fid) {
+      case 1: h.type = int32_t(rr.zigzag()); return true;
+      case 2: h.uncompressed_size = int32_t(rr.zigzag()); return true;
+      case 3: h.compressed_size = int32_t(rr.zigzag()); return true;
+      case 5:                                   // DataPageHeader
+        if (type == 12) {
+          read_struct(rr, [&](int16_t f2, uint8_t, TReader& r2) {
+            switch (f2) {
+              case 1: h.num_values = int32_t(r2.zigzag()); return true;
+              case 2: h.encoding = int32_t(r2.zigzag()); return true;
+              case 3: h.def_encoding = int32_t(r2.zigzag()); return true;
+              default: return false;
+            }
+          });
+          return true;
+        }
+        return false;
+      case 7:                                   // DictionaryPageHeader
+        if (type == 12) {
+          read_struct(rr, [&](int16_t f2, uint8_t, TReader& r2) {
+            switch (f2) {
+              case 1: h.dict_num_values = int32_t(r2.zigzag()); return true;
+              case 2: h.dict_encoding = int32_t(r2.zigzag()); return true;
+              default: return false;
+            }
+          });
+          return true;
+        }
+        return false;
+      case 8:                                   // DataPageHeaderV2
+        if (type == 12) {
+          h.type = 3;
+          read_struct(rr, [&](int16_t f2, uint8_t t2, TReader& r2) {
+            switch (f2) {
+              case 1: h.num_values = int32_t(r2.zigzag()); return true;
+              case 2: h.num_nulls = int32_t(r2.zigzag()); return true;
+              case 3: h.num_rows = int32_t(r2.zigzag()); return true;
+              case 4: h.encoding = int32_t(r2.zigzag()); return true;
+              case 5: h.def_len = int32_t(r2.zigzag()); return true;
+              case 6: h.rep_len = int32_t(r2.zigzag()); return true;
+              case 7: h.v2_compressed = t2 == 1; return true;
+              default: return false;
+            }
+          });
+          return true;
+        }
+        return false;
+      default: return false;
+    }
+  });
+  return h;
+}
+
+// decoded column chunk, pre-binding into Arrow layout
+struct DecodedChunk {
+  std::vector<uint8_t> values;    // fixed width: num_valid * width; strings: chars
+  std::vector<int32_t> lengths;   // strings: per present value
+  std::vector<uint8_t> defined;   // per row 0/1 (all 1 when required)
+  int64_t num_rows = 0;
+};
+
+struct Dict {
+  std::vector<uint8_t> fixed;     // fixed-width values
+  std::vector<std::string> binary;
+  int64_t count = 0;
+};
+
+int phys_width(int32_t pt, int32_t type_length) {
+  switch (pt) {
+    case PT_INT32: case PT_FLOAT: return 4;
+    case PT_INT64: case PT_DOUBLE: return 8;
+    case PT_INT96: return 12;
+    case PT_FLBA: return type_length;
+    default: return -1;
+  }
+}
+
+void decode_plain(int32_t pt, int32_t type_length, uint8_t const* p,
+                  uint8_t const* end, int64_t count, DecodedChunk& out) {
+  if (pt == PT_BOOLEAN) {
+    for (int64_t i = 0; i < count; i++) {
+      int64_t bit = i;
+      if (p + bit / 8 >= end) throw std::runtime_error("parquet: plain eof");
+      out.values.push_back((p[bit / 8] >> (bit % 8)) & 1);
+    }
+    return;
+  }
+  if (pt == PT_BYTE_ARRAY) {
+    for (int64_t i = 0; i < count; i++) {
+      if (end - p < 4) throw std::runtime_error("parquet: plain eof");
+      uint32_t n;
+      std::memcpy(&n, p, 4);
+      p += 4;
+      if (uint64_t(end - p) < n) throw std::runtime_error("parquet: plain eof");
+      out.values.insert(out.values.end(), p, p + n);
+      out.lengths.push_back(int32_t(n));
+      p += n;
+    }
+    return;
+  }
+  int w = phys_width(pt, type_length);
+  if (w <= 0) throw std::runtime_error("parquet: bad type width");
+  if (end - p < count * w) throw std::runtime_error("parquet: plain eof");
+  out.values.insert(out.values.end(), p, p + count * w);
+}
+
+void load_dict(int32_t pt, int32_t type_length, uint8_t const* p,
+               uint8_t const* end, int64_t count, Dict& dict) {
+  dict.count = count;
+  if (pt == PT_BYTE_ARRAY) {
+    for (int64_t i = 0; i < count; i++) {
+      if (end - p < 4) throw std::runtime_error("parquet: dict eof");
+      uint32_t n;
+      std::memcpy(&n, p, 4);
+      p += 4;
+      if (uint64_t(end - p) < n) throw std::runtime_error("parquet: dict eof");
+      dict.binary.emplace_back(reinterpret_cast<char const*>(p), n);
+      p += n;
+    }
+  } else {
+    int w = phys_width(pt, type_length);
+    if (w <= 0) throw std::runtime_error("parquet: dict on bad type");
+    if (end - p < count * w) throw std::runtime_error("parquet: dict eof");
+    dict.fixed.assign(p, p + count * w);
+  }
+}
+
+void decode_dict_indices(int32_t pt, int32_t type_length, Dict const& dict,
+                         uint8_t const* p, uint8_t const* end, int64_t count,
+                         DecodedChunk& out) {
+  if (p >= end) {
+    if (count == 0) return;
+    throw std::runtime_error("parquet: dict page eof");
+  }
+  int bw = *p++;  // leading bit width byte
+  std::vector<int32_t> idx(count);
+  rle_decode(p, end, bw, count, idx.data());
+  if (pt == PT_BYTE_ARRAY) {
+    for (int64_t i = 0; i < count; i++) {
+      if (idx[i] < 0 || idx[i] >= dict.count)
+        throw std::runtime_error("parquet: dict index out of range");
+      auto const& s = dict.binary[idx[i]];
+      out.values.insert(out.values.end(), s.begin(), s.end());
+      out.lengths.push_back(int32_t(s.size()));
+    }
+  } else {
+    int w = (pt == PT_BOOLEAN) ? 1 : phys_width(pt, type_length);
+    for (int64_t i = 0; i < count; i++) {
+      if (idx[i] < 0 || idx[i] >= dict.count)
+        throw std::runtime_error("parquet: dict index out of range");
+      out.values.insert(out.values.end(), dict.fixed.begin() + idx[i] * w,
+                        dict.fixed.begin() + (idx[i] + 1) * w);
+    }
+  }
+}
+
+DecodedChunk decode_chunk(FileState const& st, ChunkMeta const& cm,
+                          LeafSchema const& leaf) {
+  DecodedChunk out;
+  Dict dict;
+  bool have_dict = false;
+  int64_t remaining = cm.num_values;
+
+  int64_t pos = cm.dict_page_offset >= 0 &&
+                        cm.dict_page_offset < cm.data_page_offset
+                    ? cm.dict_page_offset
+                    : cm.data_page_offset;
+  uint8_t const* base = st.data_ptr;
+  uint8_t const* file_end = base + st.data_len;
+
+  while (remaining > 0) {
+    if (base + pos >= file_end) throw std::runtime_error("parquet: chunk eof");
+    TReader hr{base + pos, file_end};
+    PageHeader h = read_page_header(hr);
+    uint8_t const* body = hr.p;
+    if (file_end - body < h.compressed_size)
+      throw std::runtime_error("parquet: page body eof");
+    pos = (body - base) + h.compressed_size;
+
+    if (h.type == 2) {                      // dictionary page
+      auto plain = decompress(cm.codec, body, size_t(h.compressed_size),
+                              size_t(h.uncompressed_size));
+      load_dict(leaf.phys_type, leaf.type_length, plain.data(),
+                plain.data() + plain.size(), h.dict_num_values, dict);
+      have_dict = true;
+      continue;
+    }
+
+    std::vector<int32_t> defs;
+    std::vector<uint8_t> plain;
+    uint8_t const* vp;
+    uint8_t const* vend;
+    int64_t page_values = h.num_values;
+
+    if (h.type == 0) {                      // data page v1
+      plain = decompress(cm.codec, body, size_t(h.compressed_size),
+                         size_t(h.uncompressed_size));
+      uint8_t const* p = plain.data();
+      uint8_t const* pe = p + plain.size();
+      if (leaf.optional) {
+        if (pe - p < 4) throw std::runtime_error("parquet: def eof");
+        uint32_t dl;
+        std::memcpy(&dl, p, 4);
+        p += 4;
+        if (uint64_t(pe - p) < dl) throw std::runtime_error("parquet: def eof");
+        defs.resize(page_values);
+        rle_decode(p, p + dl, 1, page_values, defs.data());
+        p += dl;
+      }
+      vp = p;
+      vend = pe;
+    } else if (h.type == 3) {               // data page v2
+      uint8_t const* p = body;
+      if (h.rep_len)
+        throw std::runtime_error("parquet: repeated fields unsupported");
+      if (h.def_len) {
+        defs.resize(page_values);
+        rle_decode(p, p + h.def_len, 1, page_values, defs.data());
+      }
+      p += h.def_len + h.rep_len;
+      int64_t data_comp = h.compressed_size - h.def_len - h.rep_len;
+      int64_t data_un = h.uncompressed_size - h.def_len - h.rep_len;
+      if (h.v2_compressed && cm.codec != C_UNCOMPRESSED) {
+        plain = decompress(cm.codec, p, size_t(data_comp), size_t(data_un));
+        vp = plain.data();
+        vend = plain.data() + plain.size();
+      } else {
+        vp = p;
+        vend = p + data_un;
+      }
+    } else {
+      continue;                             // index or unknown page: skip
+    }
+
+    int64_t present = page_values;
+    if (!defs.empty()) {
+      present = 0;
+      for (int64_t i = 0; i < page_values; i++) {
+        out.defined.push_back(uint8_t(defs[i]));
+        if (defs[i]) present++;
+      }
+    } else {
+      out.defined.insert(out.defined.end(), size_t(page_values), uint8_t(1));
+    }
+
+    switch (h.encoding) {
+      case 0:                               // PLAIN
+        decode_plain(leaf.phys_type, leaf.type_length, vp, vend, present, out);
+        break;
+      case 2:                               // PLAIN_DICTIONARY
+      case 8:                               // RLE_DICTIONARY
+        if (!have_dict)
+          throw std::runtime_error("parquet: dictionary page missing");
+        decode_dict_indices(leaf.phys_type, leaf.type_length, dict, vp, vend,
+                            present, out);
+        break;
+      case 3: {                             // RLE (booleans)
+        if (leaf.phys_type != PT_BOOLEAN)
+          throw std::runtime_error("parquet: RLE on non-boolean");
+        if (vend - vp < 4) throw std::runtime_error("parquet: rle eof");
+        uint32_t len;
+        std::memcpy(&len, vp, 4);
+        std::vector<int32_t> vals(present);
+        rle_decode(vp + 4, vp + 4 + len, 1, present, vals.data());
+        for (int64_t i = 0; i < present; i++)
+          out.values.push_back(uint8_t(vals[i]));
+        break;
+      }
+      default:
+        throw std::runtime_error("parquet: unsupported encoding " +
+                                 std::to_string(h.encoding));
+    }
+    remaining -= page_values;
+    out.num_rows += page_values;
+  }
+  return out;
+}
+
+}  // namespace
+
+// ---- C ABI ------------------------------------------------------------------
+
+extern "C" {
+
+// copy=0: borrow the caller's buffer (caller must keep it alive until
+// pqr_free — the Python reader holds the mmap); copy=1: own a copy.
+void* pqr_open_ex(uint8_t const* buf, int64_t len, int32_t copy) {
+  try {
+    auto st = std::make_unique<FileState>();
+    if (copy) {
+      st->owned.assign(buf, buf + len);
+      st->data_ptr = st->owned.data();
+    } else {
+      st->data_ptr = buf;
+    }
+    st->data_len = size_t(len);
+    parse_footer(*st);
+    return st.release();
+  } catch (std::exception const& e) {
+    g_error = e.what();
+    return nullptr;
+  }
+}
+
+void* pqr_open(uint8_t const* buf, int64_t len) {
+  return pqr_open_ex(buf, len, 1);
+}
+
+char const* pqr_last_error() { return g_error.c_str(); }
+
+int64_t pqr_num_rows(void* h) { return static_cast<FileState*>(h)->num_rows; }
+
+int32_t pqr_num_row_groups(void* h) {
+  return int32_t(static_cast<FileState*>(h)->groups.size());
+}
+
+int32_t pqr_num_leaves(void* h) {
+  return int32_t(static_cast<FileState*>(h)->leaves.size());
+}
+
+int64_t pqr_row_group_num_rows(void* h, int32_t rg) {
+  auto* st = static_cast<FileState*>(h);
+  if (rg < 0 || size_t(rg) >= st->groups.size()) return -1;
+  return st->groups[rg].num_rows;
+}
+
+// leaf schema accessors: name into caller buffer; ints via out params
+int32_t pqr_leaf_info(void* h, int32_t i, char* name_out, int32_t name_cap,
+                      int32_t* phys_type, int32_t* type_length,
+                      int32_t* converted, int32_t* scale, int32_t* precision,
+                      int32_t* optional, int32_t* flat) {
+  auto* st = static_cast<FileState*>(h);
+  if (i < 0 || size_t(i) >= st->leaves.size()) return -1;
+  auto const& l = st->leaves[i];
+  if (int32_t(l.name.size()) + 1 > name_cap) return int32_t(l.name.size()) + 1;
+  std::memcpy(name_out, l.name.c_str(), l.name.size() + 1);
+  *phys_type = l.phys_type;
+  *type_length = l.type_length;
+  *converted = l.converted;
+  *scale = l.scale;
+  *precision = l.precision;
+  *optional = l.optional ? 1 : 0;
+  *flat = l.flat ? 1 : 0;
+  return 0;
+}
+
+// Two-phase column read for one row group.
+// Phase 1 (values==nullptr): returns 0 and fills *values_nbytes /
+// *num_present. Phase 2: fills values (dense, nulls squeezed out),
+// lengths (strings; else ignored), defined (num_rows bytes).
+int32_t pqr_read_column(void* h, int32_t rg, int32_t leaf,
+                        uint8_t* values, int64_t* values_nbytes,
+                        int32_t* lengths, uint8_t* defined,
+                        int64_t* num_present) {
+  auto* st = static_cast<FileState*>(h);
+  try {
+    if (rg < 0 || size_t(rg) >= st->groups.size())
+      throw std::runtime_error("row group out of range");
+    auto const& grp = st->groups[rg];
+    ChunkMeta const* cm = nullptr;
+    for (auto const& c : grp.chunks)
+      if (c.schema_idx == leaf) { cm = &c; break; }
+    if (!cm) throw std::runtime_error("column chunk not found");
+    auto const& lf = st->leaves[leaf];
+    if (!lf.flat)
+      throw std::runtime_error("nested/repeated columns unsupported");
+
+    // one decode per (rg, leaf): the sizing call caches, the fill call
+    // consumes (so chunks are never decompressed twice)
+    auto key = std::make_pair(rg, leaf);
+    std::shared_ptr<DecodedChunk> dcp;
+    {
+      std::lock_guard<std::mutex> lk(st->cache_mu);
+      auto it = st->cache.find(key);
+      if (it != st->cache.end()) {
+        dcp = it->second;
+        if (values) st->cache.erase(it);
+      }
+    }
+    if (!dcp) {
+      dcp = std::make_shared<DecodedChunk>(decode_chunk(*st, *cm, lf));
+      if (!values) {
+        std::lock_guard<std::mutex> lk(st->cache_mu);
+        st->cache[key] = dcp;
+      }
+    }
+    DecodedChunk const& dc = *dcp;
+    int64_t present = 0;
+    for (uint8_t d : dc.defined) present += d;
+    if (!values) {
+      *values_nbytes = int64_t(dc.values.size());
+      *num_present = present;
+      return 0;
+    }
+    std::memcpy(values, dc.values.data(), dc.values.size());
+    if (lengths && !dc.lengths.empty())
+      std::memcpy(lengths, dc.lengths.data(),
+                  dc.lengths.size() * sizeof(int32_t));
+    if (defined)
+      std::memcpy(defined, dc.defined.data(), dc.defined.size());
+    *values_nbytes = int64_t(dc.values.size());
+    *num_present = present;
+    return 0;
+  } catch (std::exception const& e) {
+    g_error = e.what();
+    return -1;
+  }
+}
+
+void pqr_free(void* h) { delete static_cast<FileState*>(h); }
+
+}  // extern "C"

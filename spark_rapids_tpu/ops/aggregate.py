@@ -92,16 +92,21 @@ def _groupby_kernel(key_operands, agg_datas, agg_valids, *, n_ops: int,
                                                 axis=0), 0)
         return at_end - at_prev
 
-    def segmented_extreme(vals, is_min: bool):
-        """Running min/max that resets at boundaries; segment result sits at
-        the segment's last row."""
+    def segmented_scan(vals, kind: str):
+        """Running sum/min/max that resets at boundaries; segment result
+        sits at the segment's last row. Floats use this for sums too — a
+        global-cumsum difference would let one NaN/Inf poison every group
+        sorted after it."""
         def combine(a, b):
             abound, aval = a
             bbound, bval = b
-            merged = jnp.where(bbound, bval,
-                               jnp.minimum(aval, bval) if is_min
-                               else jnp.maximum(aval, bval))
-            return abound | bbound, merged
+            if kind == "sum":
+                merged0 = aval + bval
+            elif kind == "min":
+                merged0 = jnp.minimum(aval, bval)
+            else:
+                merged0 = jnp.maximum(aval, bval)
+            return abound | bbound, jnp.where(bbound, bval, merged0)
         _, res = jax.lax.associative_scan(combine, (boundary, vals))
         return jnp.take(res, last, axis=0)
 
@@ -120,10 +125,15 @@ def _groupby_kernel(key_operands, agg_datas, agg_valids, *, n_ops: int,
         v = jnp.take(data, order, axis=0)
         if op in ("sum", "mean"):
             if v.dtype.kind == "f" or op == "mean":
+                # segmented scan, NOT cumsum-difference: NaN/Inf must stay
+                # confined to their own group
                 acc = jnp.where(ok, v.astype(jnp.float64), 0.0)
+                s = segmented_scan(acc, "sum")
             else:
+                # int64 cumsum-difference is exact under two's-complement
+                # wraparound (Java long semantics) and immune to poisoning
                 acc = jnp.where(ok, v.astype(jnp.int64), jnp.int64(0))
-            s = ends_minus_starts(jnp.cumsum(acc))
+                s = ends_minus_starts(jnp.cumsum(acc))
             if op == "mean":
                 s = s / jnp.where(cnt == 0, 1, cnt).astype(jnp.float64)
             outs.append((s, cnt > 0))
@@ -138,7 +148,7 @@ def _groupby_kernel(key_operands, agg_datas, agg_valids, *, n_ops: int,
             info = jnp.iinfo(tv.dtype)
             ident = jnp.asarray(info.max if op == "min" else info.min, tv.dtype)
             masked = jnp.where(ok, tv, ident)
-            ext = segmented_extreme(masked, op == "min")
+            ext = segmented_scan(masked, "min" if op == "min" else "max")
             sign_bit = jnp.asarray(info.min, tv.dtype)
             bits = jnp.where(ext < 0, ~(ext ^ sign_bit), ext)
             outs.append((jax.lax.bitcast_convert_type(bits, v.dtype), cnt > 0))
@@ -146,7 +156,8 @@ def _groupby_kernel(key_operands, agg_datas, agg_valids, *, n_ops: int,
             info = jnp.iinfo(v.dtype)
             ident = jnp.asarray(info.max if op == "min" else info.min, v.dtype)
             masked = jnp.where(ok, v, ident)
-            outs.append((segmented_extreme(masked, op == "min"), cnt > 0))
+            outs.append((segmented_scan(masked, "min" if op == "min" else "max"),
+                         cnt > 0))
 
     return num_groups, starts, order, outs
 
@@ -197,7 +208,8 @@ def groupby_aggregate(table: Table,
 
     # key columns: row index (original frame) of each group's first sorted row
     first_rows = jnp.take(order, first_sorted[:g], axis=0)
-    out_cols = [take(c, first_rows) for c in keys]
+    # first_rows is non-negative by construction: skip take()'s any<0 sync
+    out_cols = [take(c, first_rows, _has_negative=False) for c in keys]
     names = [table.names[k] if isinstance(k, int) else k for k in key_names]
 
     for (data, valid), (col_ref, op) in zip(outs, aggs):

@@ -84,8 +84,10 @@ def take(col: Column, idx: jnp.ndarray, check_bounds: bool = False,
     return Column(dtype=col.dtype, length=m, data=data, validity=validity)
 
 
-def take_table(table: Table, idx: jnp.ndarray) -> Table:
+def take_table(table: Table, idx: jnp.ndarray,
+               _has_negative: bool = None) -> Table:
     idx = jnp.asarray(idx)
-    has_neg = int(idx.shape[0]) > 0 and bool(jnp.any(idx < 0))
-    return Table([take(c, idx, _has_negative=has_neg) for c in table.columns],
-                 names=table.names)
+    if _has_negative is None:
+        _has_negative = int(idx.shape[0]) > 0 and bool(jnp.any(idx < 0))
+    return Table([take(c, idx, _has_negative=_has_negative)
+                  for c in table.columns], names=table.names)

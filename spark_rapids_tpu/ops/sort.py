@@ -152,4 +152,5 @@ def sort_table(table: Table,
     else:
         keys = [table[k] for k in key_names]
     order = sorted_order(keys, ascending, null_precedence, stable)
-    return take_table(table, order.data)
+    # a permutation is never negative: skip take_table's any<0 sync
+    return take_table(table, order.data, _has_negative=False)

@@ -1,0 +1,196 @@
+"""Chunked parquet reader tests. Oracle: pyarrow writes the files AND
+provides the expected decoded values (the reference's parquet tests likewise
+write with parquet-avro/hadoop and compare — SURVEY.md §4 tier 2)."""
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import spark_rapids_tpu  # noqa: F401
+from spark_rapids_tpu.io import ParquetChunkedReader, read_parquet
+
+
+def _write(tmp_path, table: pa.Table, name="t.parquet", **kw):
+    path = str(tmp_path / name)
+    pq.write_table(table, path, **kw)
+    return path
+
+
+def _ref_lists(table: pa.Table):
+    return {name: table.column(name).to_pylist()
+            for name in table.column_names}
+
+
+def _check(path, ref: dict, columns=None):
+    got = read_parquet(path, columns=columns)
+    names = columns if columns is not None else list(ref)
+    assert list(got.names) == list(names)
+    for n in names:
+        mine = got[n].to_pylist()
+        theirs = ref[n]
+        if got[n].dtype.is_floating:
+            assert len(mine) == len(theirs)
+            for a, b in zip(mine, theirs):
+                assert (a is None) == (b is None)
+                if a is not None:
+                    assert a == pytest.approx(b, rel=1e-6)
+        else:
+            assert mine == theirs, f"column {n}"
+
+
+def test_plain_types_roundtrip(tmp_path):
+    n = 1000
+    rng = np.random.default_rng(0)
+    t = pa.table({
+        "i32": pa.array(rng.integers(-2**31, 2**31 - 1, n), pa.int32()),
+        "i64": pa.array(rng.integers(-2**62, 2**62, n), pa.int64()),
+        "f32": pa.array(rng.standard_normal(n), pa.float32()),
+        "f64": pa.array(rng.standard_normal(n), pa.float64()),
+        "b": pa.array(rng.integers(0, 2, n) == 1, pa.bool_()),
+    })
+    path = _write(tmp_path, t, use_dictionary=False, compression="NONE")
+    _check(path, _ref_lists(t))
+
+
+def test_strings_with_nulls_and_dictionary(tmp_path):
+    vals = ["alpha", None, "", "beta", "alpha", None, "γunicodeγ", "beta"] * 50
+    t = pa.table({"s": pa.array(vals, pa.string())})
+    for comp, dict_on in (("NONE", True), ("SNAPPY", True), ("ZSTD", False)):
+        path = _write(tmp_path, t, use_dictionary=dict_on, compression=comp,
+                      name=f"s_{comp}.parquet")
+        _check(path, _ref_lists(t))
+
+
+def test_codecs(tmp_path):
+    n = 5000
+    rng = np.random.default_rng(1)
+    # low-cardinality ints → dictionary pages; plus nulls
+    raw = rng.integers(0, 50, n).astype(np.int64)
+    mask = rng.random(n) < 0.1
+    vals = [None if m else int(v) for v, m in zip(raw, mask)]
+    t = pa.table({"x": pa.array(vals, pa.int64())})
+    for comp in ("NONE", "SNAPPY", "GZIP", "ZSTD"):
+        path = _write(tmp_path, t, compression=comp, name=f"c_{comp}.parquet")
+        _check(path, _ref_lists(t))
+
+
+def test_multiple_row_groups_chunked(tmp_path):
+    n = 10_000
+    t = pa.table({"x": pa.array(np.arange(n), pa.int64())})
+    path = _write(tmp_path, t, row_group_size=1024)
+    with ParquetChunkedReader(path) as r:
+        assert r.num_row_groups == (n + 1023) // 1024
+        total = []
+        n_chunks = 0
+        while r.has_next():
+            chunk = r.read_chunk()
+            assert chunk.num_rows <= 1024
+            total.extend(chunk["x"].to_pylist())
+            n_chunks += 1
+        assert n_chunks == r.num_row_groups
+        assert total == list(range(n))
+
+
+def test_column_projection(tmp_path):
+    t = pa.table({"a": pa.array([1, 2, 3], pa.int32()),
+                  "b": pa.array(["x", "y", "z"]),
+                  "c": pa.array([1.5, 2.5, 3.5], pa.float64())})
+    path = _write(tmp_path, t)
+    _check(path, _ref_lists(t), columns=["c", "a"])
+    with pytest.raises(KeyError):
+        read_parquet(path, columns=["nope"])
+
+
+def test_date_and_timestamps(tmp_path):
+    import datetime
+    days = [datetime.date(2020, 1, 1), None, datetime.date(1969, 12, 31)]
+    us = [datetime.datetime(2023, 5, 17, 1, 2, 3, 123456), None,
+          datetime.datetime(1960, 1, 1)]
+    t = pa.table({"d": pa.array(days, pa.date32()),
+                  "ts": pa.array(us, pa.timestamp("us"))})
+    path = _write(tmp_path, t)
+    got = read_parquet(path)
+    assert got["d"].dtype == spark_rapids_tpu.dtypes.DATE32
+    assert got["d"].to_pylist() == [18262, None, -1]
+    assert got["ts"].dtype == spark_rapids_tpu.dtypes.TIMESTAMP_US
+    epoch = datetime.datetime(1970, 1, 1)
+    ref = [None if x is None else
+           int((x - epoch) // datetime.timedelta(microseconds=1)) for x in us]
+    assert got["ts"].to_pylist() == ref
+
+
+def test_int96_legacy_timestamps(tmp_path):
+    import datetime
+    us = [datetime.datetime(2001, 2, 3, 4, 5, 6, 789000), None,
+          datetime.datetime(1970, 1, 1)]
+    t = pa.table({"ts": pa.array(us, pa.timestamp("us"))})
+    path = str(tmp_path / "i96.parquet")
+    pq.write_table(t, path, use_deprecated_int96_timestamps=True)
+    got = read_parquet(path)
+    assert got["ts"].dtype == spark_rapids_tpu.dtypes.TIMESTAMP_US
+    epoch = datetime.datetime(1970, 1, 1)
+    ref = [None if x is None else
+           int((x - epoch) // datetime.timedelta(microseconds=1)) for x in us]
+    assert got["ts"].to_pylist() == ref
+
+
+def test_decimal128_flba(tmp_path):
+    vals = [decimal.Decimal("123456789012345678901234.567"), None,
+            decimal.Decimal("-0.001"), decimal.Decimal("99.999")]
+    t = pa.table({"dec": pa.array(vals, pa.decimal128(38, 3))})
+    path = _write(tmp_path, t)
+    got = read_parquet(path)
+    assert got["dec"].dtype.kind == spark_rapids_tpu.dtypes.Kind.DECIMAL128
+    assert got["dec"].dtype.scale == 3
+    unscaled = [None if v is None else int(v.scaleb(3)) for v in vals]
+    assert got["dec"].to_pylist() == unscaled
+
+
+def test_decimal64_int_backed(tmp_path):
+    vals = [decimal.Decimal("12.34"), decimal.Decimal("-5.00"), None]
+    t = pa.table({"d": pa.array(vals, pa.decimal128(10, 2))})
+    # force int64 storage for small precision
+    path = str(tmp_path / "d64.parquet")
+    pq.write_table(t, path, store_decimal_as_integer=True)
+    got = read_parquet(path)
+    assert got["d"].dtype.kind in (spark_rapids_tpu.dtypes.Kind.DECIMAL32,
+                                   spark_rapids_tpu.dtypes.Kind.DECIMAL64)
+    assert got["d"].to_pylist() == [1234, -500, None]
+
+
+def test_data_page_v2(tmp_path):
+    vals = [None if i % 7 == 0 else i * 11 for i in range(3000)]
+    t = pa.table({"x": pa.array(vals, pa.int64())})
+    path = _write(tmp_path, t, data_page_version="2.0", compression="SNAPPY")
+    _check(path, _ref_lists(t))
+
+
+def test_all_nulls_column(tmp_path):
+    t = pa.table({"x": pa.array([None, None, None], pa.int32())})
+    path = _write(tmp_path, t)
+    assert read_parquet(path)["x"].to_pylist() == [None, None, None]
+
+
+def test_empty_file(tmp_path):
+    t = pa.table({"x": pa.array([], pa.int64()),
+                  "s": pa.array([], pa.string())})
+    path = _write(tmp_path, t)
+    got = read_parquet(path)
+    assert got.num_rows == 0 and got["x"].to_pylist() == []
+
+
+def test_random_mixed_against_pyarrow(tmp_path):
+    rng = np.random.default_rng(7)
+    n = 20_000
+    mask = rng.random(n) < 0.15
+    ints = [None if m else int(v) for m, v in
+            zip(mask, rng.integers(-10**12, 10**12, n))]
+    strs = [None if rng.random() < 0.1 else
+            "".join(chr(97 + int(c)) for c in rng.integers(0, 26, rng.integers(0, 12)))
+            for _ in range(n)]
+    t = pa.table({"i": pa.array(ints, pa.int64()),
+                  "s": pa.array(strs, pa.string())})
+    path = _write(tmp_path, t, row_group_size=4096, compression="SNAPPY")
+    _check(path, _ref_lists(t))

@@ -258,6 +258,19 @@ def test_groupby_float_min_max_nan_semantics():
     assert np.isnan(maxs[0]) and np.isnan(maxs[1])
 
 
+def test_groupby_float_sum_nan_inf_stay_confined():
+    # a NaN/Inf in one group must not poison later groups' sums (global
+    # cumsum-difference would produce NaN - NaN = NaN everywhere after)
+    k = col([1, 2, 3, 3], np.int32)
+    v = col([np.nan, np.inf, 1.5, 2.5], np.float64)
+    out = groupby_aggregate(Table([k, v], names=["k", "v"]), ["k"],
+                            [("v", "sum"), ("v", "mean")])
+    sums = out["sum(v)"].to_pylist()
+    assert np.isnan(sums[0]) and sums[1] == np.inf and sums[2] == 4.0
+    means = out["mean(v)"].to_pylist()
+    assert np.isnan(means[0]) and means[1] == np.inf and means[2] == 2.0
+
+
 def test_join_rejects_mismatched_decimal_scales():
     from spark_rapids_tpu.ops import string_to_decimal
     a = string_to_decimal(scol(["1.00"]), precision=18, scale=2)
