@@ -8,6 +8,7 @@ cd "$(dirname "$0")/.."
 python -m pytest tests/ -q
 python benchmarks/run_all.py --scale 0.01 --iters 5
 ./ci/fuzz-test.sh
+./ci/sanitizer.sh
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('multichip OK')"
 echo "nightly OK"

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Native-test + sanitizer tier (reference: gtest executables, SURVEY.md §4
+# tier 1, and the Compute Sanitizer run, tier 3). Compiles the native test
+# driver WITH the library sources under ASan+UBSan and runs it directly —
+# every C++ path memcheck'd with no interpreter in the way.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=$(mktemp -d)
+trap 'rm -rf "$OUT"' EXIT
+
+python - <<EOF
+import numpy as np, pyarrow as pa, pyarrow.parquet as pq
+n = 1000
+t = pa.table({
+    "x": pa.array(np.arange(n), pa.int64()),
+    "s": pa.array([None if i % 9 == 0 else f"s{i % 50}" for i in range(n)]),
+})
+pq.write_table(t, "$OUT/smoke.parquet", row_group_size=256,
+               compression="SNAPPY")
+EOF
+
+g++ -std=c++17 -O1 -g -pthread -fsanitize=address,undefined \
+    -fno-omit-frame-pointer -Wall -Wextra \
+    -o "$OUT/native_smoke" \
+    spark_rapids_tpu/native/tests/native_smoke.cpp \
+    spark_rapids_tpu/native/resource_adaptor.cpp \
+    spark_rapids_tpu/native/parquet_reader.cpp \
+    spark_rapids_tpu/native/parquet_footer.cpp \
+    -lz -lzstd -l:libsnappy.so.1
+
+ASAN_OPTIONS="abort_on_error=1" UBSAN_OPTIONS="halt_on_error=1" \
+    "$OUT/native_smoke" "$OUT/smoke.parquet"
+echo "sanitizer OK"

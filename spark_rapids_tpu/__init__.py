@@ -13,7 +13,9 @@ idiomatic JAX/XLA/Pallas/PJRT stack:
   TPU equivalent of SparkResourceAdaptor (SURVEY.md §2.2).
 - `parallel`: device-mesh sharding + ICI/DCN all-to-all partition exchange
   (the slot the GPU stack fills with UCX shuffle).
-- `io`: native parquet footer parse/prune/filter.
+- `io`: native parquet footer parse/prune/filter + chunked page reader.
+- `interop`: Arrow C Data Interface export/import (JVM-facing surface).
+- `faultinj`: config-driven fault injection over the device-call surface.
 
 int64 is pervasive in Spark data (timestamps, longs, xxhash64), so this
 package enables jax x64 mode on import; XLA:TPU emulates s64/u64 with 32-bit

@@ -31,7 +31,12 @@ def lib_path(name: str) -> str:
 
 
 def build(name: str) -> str:
-    """Compile lib<name>.so from its sources if stale; return its path."""
+    """Compile lib<name>.so from its sources if stale; return its path.
+
+    The sanitizer tier does NOT go through here: ci/sanitizer.sh compiles
+    the same sources into a native test driver with ASan+UBSan and runs it
+    directly (sanitizing through the interpreter trips ASan's interceptor
+    init when only the .so is instrumented)."""
     srcs = [os.path.join(_HERE, s) for s in _SOURCES[name]]
     out = lib_path(name)
     with _LOCK:

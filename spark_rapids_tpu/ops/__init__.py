@@ -26,7 +26,7 @@ from .parse_uri import (parse_uri_to_protocol, parse_uri_to_host,
                         parse_uri_to_query_column)
 from .histogram import create_histogram_if_valid, percentile_from_histogram
 from .map_utils import from_json
-from .gather import take, take_table
+from .gather import take, take_table, apply_boolean_mask
 from .sort import sorted_order, sort_table
 from .aggregate import groupby_aggregate
 from .join import inner_join, left_join, left_semi_join, left_anti_join
@@ -51,7 +51,7 @@ __all__ = [
     "parse_uri_to_query_literal", "parse_uri_to_query_column",
     "create_histogram_if_valid", "percentile_from_histogram",
     "from_json",
-    "take", "take_table", "sorted_order", "sort_table",
+    "take", "take_table", "apply_boolean_mask", "sorted_order", "sort_table",
     "groupby_aggregate",
     "inner_join", "left_join", "left_semi_join", "left_anti_join",
 ]

@@ -84,6 +84,26 @@ def take(col: Column, idx: jnp.ndarray, check_bounds: bool = False,
     return Column(dtype=col.dtype, length=m, data=data, validity=validity)
 
 
+def apply_boolean_mask(table_or_col, mask) -> "Table":
+    """Keep rows where mask is True (cudf::apply_boolean_mask — the filter
+    half of read → filter → project). Null mask entries drop the row, like
+    Spark's WHERE over a nullable predicate."""
+    if isinstance(mask, Column):
+        m = mask.data
+        if mask.validity is not None:
+            m = m & mask.validity
+    else:
+        m = jnp.asarray(mask)
+    n = (table_or_col.num_rows if isinstance(table_or_col, Table)
+         else table_or_col.length)
+    if m.shape != (n,):
+        raise ValueError(f"mask length {m.shape} does not match {n} rows")
+    keep = jnp.nonzero(m)[0].astype(jnp.int32)   # host sync: result size
+    if isinstance(table_or_col, Table):
+        return take_table(table_or_col, keep, _has_negative=False)
+    return take(table_or_col, keep, _has_negative=False)
+
+
 def take_table(table: Table, idx: jnp.ndarray,
                _has_negative: bool = None) -> Table:
     idx = jnp.asarray(idx)

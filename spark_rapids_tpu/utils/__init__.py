@@ -1,3 +1,5 @@
 from .bitmask import pack_validity, unpack_validity, bitmask_bitwise_or
+from .tracing import func_range, range_ctx, start_trace, stop_trace, trace
 
-__all__ = ["pack_validity", "unpack_validity", "bitmask_bitwise_or"]
+__all__ = ["pack_validity", "unpack_validity", "bitmask_bitwise_or",
+           "func_range", "range_ctx", "start_trace", "stop_trace", "trace"]
