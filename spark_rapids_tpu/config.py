@@ -1,0 +1,41 @@
+"""Runtime configuration knobs (env vars).
+
+The reference exposes runtime knobs as Java system properties and env vars
+(SURVEY.md §5 "Config/flag system": `ai.rapids.cudf.spark.
+rmmWatchdogPollingPeriod`, `ai.rapids.cudf.nvtx.enabled`,
+`CUDA_INJECTION64_PATH`, `FAULT_INJECTOR_CONFIG_PATH`). The TPU engine's
+equivalents, all read at use time (not import time) so tests can monkeypatch:
+
+| env var | default | meaning |
+|---|---|---|
+| SPARK_RAPIDS_TPU_WATCHDOG_PERIOD_MS | 100 | arbiter deadlock-poll cadence |
+| SPARK_RAPIDS_TPU_RETRY_LIMIT     | 500  | livelock cap before hard OOM   |
+| SPARK_RAPIDS_TPU_TRACE           | 0    | profiler ranges (utils/tracing)|
+| TPU_FAULT_INJECTOR_CONFIG_PATH   | —    | fault injector config (faultinj)|
+"""
+from __future__ import annotations
+
+import os
+
+
+def _int_env(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def watchdog_period_s() -> float:
+    """Deadlock-watchdog poll period (reference default: 100 ms,
+    SparkResourceAdaptor.java:35-36)."""
+    return _int_env("SPARK_RAPIDS_TPU_WATCHDOG_PERIOD_MS", 100) / 1000.0
+
+
+def retry_limit() -> int:
+    """Consecutive no-progress retries before a hard OOM (reference: 500,
+    SparkResourceAdaptorJni.cpp:984-995)."""
+    return _int_env("SPARK_RAPIDS_TPU_RETRY_LIMIT", 500)
+
+
+def trace_enabled() -> bool:
+    return os.environ.get("SPARK_RAPIDS_TPU_TRACE", "") == "1"

@@ -314,11 +314,8 @@ def _assemble(leaf: _Leaf, values: np.ndarray, lengths: np.ndarray,
 
 
 def _concat_tables(tables: List[Table]) -> Table:
-    from ..ops.join import _concat_columns
-    out = tables[0].columns
-    for t in tables[1:]:
-        out = [_concat_columns(a, b) for a, b in zip(out, t.columns)]
-    return Table(out, names=tables[0].names)
+    from ..ops.copying import concat_tables
+    return concat_tables(tables)
 
 
 def read_parquet(source: Union[str, bytes],

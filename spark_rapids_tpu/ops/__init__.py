@@ -30,6 +30,9 @@ from .gather import take, take_table, apply_boolean_mask
 from .sort import sorted_order, sort_table
 from .aggregate import groupby_aggregate
 from .join import inner_join, left_join, left_semi_join, left_anti_join
+from .copying import (concat_columns, concat_tables, slice_table,
+                      split_table, halve_table, replace_nulls, if_else,
+                      drop_duplicates)
 
 __all__ = [
     "murmur_hash3_32", "xxhash64", "DEFAULT_XXHASH64_SEED",
@@ -54,4 +57,6 @@ __all__ = [
     "take", "take_table", "apply_boolean_mask", "sorted_order", "sort_table",
     "groupby_aggregate",
     "inner_join", "left_join", "left_semi_join", "left_anti_join",
+    "concat_columns", "concat_tables", "slice_table", "split_table",
+    "halve_table", "replace_nulls", "if_else", "drop_duplicates",
 ]

@@ -62,8 +62,8 @@ def _groupby_kernel(key_operands, agg_datas, agg_valids, *, n_ops: int,
       sum(group j)  = cumsum[end_j - 1] - cumsum[start_j - 1]
       min/max       = segmented running-min via ONE associative_scan that
                       resets at group boundaries, read at end_j - 1
-      starts/ends   = searchsorted(sorted_gid, iota)  (binary search, no
-                      scatter; padded to n so shapes stay static)
+      starts/ends   = boundary-compaction sort (one extra 2-operand int32
+                      sort; padded to n so shapes stay static)
 
     This is ~12x faster than segment_sum-based aggregation at 10M rows.
     """
@@ -76,13 +76,21 @@ def _groupby_kernel(key_operands, agg_datas, agg_valids, *, n_ops: int,
     neq = jnp.zeros((n,), bool)
     for o in sorted_ops:
         neq = neq | (o != jnp.roll(o, 1))
-    boundary = neq.at[0].set(n > 0)
+    boundary = neq.at[0].set(True) if n else neq   # guard: empty scatter OOB
     gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
     num_groups = (gid[-1] + 1) if n else jnp.int32(0)
     # group start/end positions in the sorted frame, padded to n entries
-    # (entries past num_groups are n/garbage and sliced off by the caller)
-    starts = jnp.searchsorted(gid, iota, side="left").astype(jnp.int32)
-    ends = jnp.searchsorted(gid, iota, side="right").astype(jnp.int32)
+    # (entries past num_groups are n and sliced off by the caller).
+    # Boundary-compaction sort, NOT searchsorted: jnp.searchsorted lowers to
+    # ~log2(n) whole-array gather passes on TPU (~2s at 10M), while one more
+    # 2-operand int32 sort is ~40ms.
+    flag = jnp.where(boundary, jnp.int32(0), jnp.int32(1))
+    payload = jnp.where(boundary, iota, jnp.int32(n))
+    starts = jax.lax.sort([flag, payload], num_keys=1, is_stable=True)[1]
+    if n:
+        ends = jnp.concatenate([starts[1:], jnp.full((1,), n, jnp.int32)])
+    else:
+        ends = starts
     last = jnp.clip(ends - 1, 0, max(n - 1, 0))
     prev = starts - 1  # -1 for group 0 → masked below
 

@@ -84,7 +84,7 @@ def take(col: Column, idx: jnp.ndarray, check_bounds: bool = False,
     return Column(dtype=col.dtype, length=m, data=data, validity=validity)
 
 
-def apply_boolean_mask(table_or_col, mask) -> "Table":
+def apply_boolean_mask(table_or_col, mask) -> Union[Table, Column]:
     """Keep rows where mask is True (cudf::apply_boolean_mask — the filter
     half of read → filter → project). Null mask entries drop the row, like
     Spark's WHERE over a nullable predicate."""

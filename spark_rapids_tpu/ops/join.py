@@ -12,12 +12,14 @@ dynamic occupancy); XLA's sorter + searchsorted are native. The join is:
    string words — is consistent), run-boundary prefix-sum → every row gets a
    dense int32 rank; equal keys ⇔ equal ranks. This reduces any multi-column,
    any-dtype equi-join to an int32 join.
-2. sort right ranks once; binary-search (searchsorted) each left rank for
-   its [lo, hi) match span — counts = hi - lo.
-3. expand: exclusive-scan the counts, then one searchsorted over the output
-   iota recovers (left row, k-th match) for every output slot. Both sides
-   come back as gather maps; -1 marks outer-join non-matches (take() turns
-   them into null rows).
+2. sort-merge the spans: two combined (rank, side) sorts give every left
+   row its [lo, hi) match span in the rank-sorted right side (counts of
+   right ranks < / <= each left rank) — no binary search, which would
+   lower to ~log2(n) whole-array gather passes on TPU.
+3. expand: exclusive-scan the counts, then jnp.repeat (cumsum + scatter
+   under the hood) recovers (left row, k-th match) for every output slot.
+   Both sides come back as gather maps; -1 marks outer-join non-matches
+   (take() turns them into null rows).
 
 Null keys never match (Spark equi-join); null-safe equality (<=>) is the
 `null_equal` flag, like cudf's null_equality::EQUAL.
@@ -39,28 +41,14 @@ __all__ = ["inner_join", "left_join", "left_semi_join", "left_anti_join"]
 
 
 def _concat_columns(a: Column, b: Column) -> Column:
-    """Concatenate two columns of the same dtype (cudf::concatenate)."""
-    if a.dtype != b.dtype:
-        # full equality: decimal keys with different scale/precision would
-        # otherwise be compared on raw unscaled values (cudf also rejects)
-        raise TypeError(f"join key dtype mismatch: {a.dtype} vs {b.dtype}")
-    n = a.length + b.length
-    if a.validity is not None or b.validity is not None:
-        va = a.validity if a.validity is not None else jnp.ones((a.length,), bool)
-        vb = b.validity if b.validity is not None else jnp.ones((b.length,), bool)
-        validity = jnp.concatenate([va, vb])
-    else:
-        validity = None
-    if a.dtype.kind == Kind.STRING:
-        chars = jnp.concatenate([a.data, b.data])
-        off_b = b.offsets[1:] + a.data.shape[0]
-        offsets = jnp.concatenate([a.offsets, off_b.astype(jnp.int32)])
-        return Column(dtype=a.dtype, length=n, data=chars,
-                      offsets=offsets, validity=validity)
-    if a.dtype.kind in (Kind.LIST, Kind.STRUCT):
-        raise TypeError("nested join keys are not supported")
-    return Column(dtype=a.dtype, length=n,
-                  data=jnp.concatenate([a.data, b.data]), validity=validity)
+    """Concatenate two same-dtype key columns. Full dtype equality is
+    required: decimal keys with different scale/precision would otherwise be
+    compared on raw unscaled values (cudf also rejects)."""
+    from .copying import _concat2
+    try:
+        return _concat2(a, b)
+    except TypeError as e:
+        raise TypeError(f"join key {e}") from None
 
 
 @partial(jax.jit, static_argnames=("n_ops",))
@@ -73,7 +61,9 @@ def _union_ranks(operands, *, n_ops: int) -> jnp.ndarray:
     neq = jnp.zeros((n,), bool)
     for o in sorted_ops:
         neq = neq | (o != jnp.roll(o, 1))
-    gid = jnp.cumsum(neq.at[0].set(False).astype(jnp.int32))
+    if n:
+        neq = neq.at[0].set(False)                 # guard: empty scatter OOB
+    gid = jnp.cumsum(neq.astype(jnp.int32))
     # scatter back to original row order
     ranks = jnp.zeros((n,), jnp.int32).at[order].set(gid)
     return ranks
@@ -82,17 +72,48 @@ def _union_ranks(operands, *, n_ops: int) -> jnp.ndarray:
 @jax.jit
 def _match_spans(lrank, lvalid, rrank, rvalid):
     """Per-left-row [lo, hi) span of matching rows in the rank-sorted right
-    side, plus that sorted right order. Invalid (null-key) rows never match."""
+    side, plus that sorted right order. Invalid (null-key) rows never match.
+
+    Sort-merge, not binary search: jnp.searchsorted lowers to ~log2(n)
+    whole-array gather passes on TPU (~1.6s at 10M×1M), while lax.sort +
+    cumsum + one int32 scatter are each tens of ms. Both span endpoints come
+    from ONE combined sort each:
+
+      hi[i] = #right rows with rank <= lrank[i]  → sort (rank, side) with
+              right-before-left on ties; prefix-count of right entries at
+              each left row's sorted position
+      lo[i] = #right rows with rank <  lrank[i]  → same with left first
+    """
+    nl = lrank.shape[0]
     nr = rrank.shape[0]
-    # push null-key right rows to the end and shrink the searched span
     big = jnp.int32(2**31 - 1)
-    rkey = jnp.where(rvalid, rrank, big)
-    rorder = jnp.argsort(rkey, stable=True).astype(jnp.int32)
-    rsorted = jnp.take(rkey, rorder, axis=0)
+    rkey = jnp.where(rvalid, rrank, big)      # null-key right rows at the end
+    rorder_out = jax.lax.sort([rkey, jnp.arange(nr, dtype=jnp.int32)],
+                              num_keys=1, is_stable=True)
+    rorder = rorder_out[1]
+
+    keys = jnp.concatenate([lrank, rkey])
+    payload = jnp.arange(nl + nr, dtype=jnp.int32)   # <nl: left row id
+
+    def spans(left_tie_flag):
+        # ties: smaller flag sorts first
+        flags = jnp.concatenate([
+            jnp.full((nl,), left_tie_flag, jnp.int32),
+            jnp.full((nr,), 1 - left_tie_flag, jnp.int32)])
+        k_s, f_s, p_s = jax.lax.sort([keys, flags, payload], num_keys=2,
+                                     is_stable=True)
+        is_right = f_s == (1 - left_tie_flag)
+        rcount = jnp.cumsum(is_right.astype(jnp.int32))  # inclusive
+        # count of right entries strictly BEFORE each position
+        before = rcount - is_right.astype(jnp.int32)
+        # route each position's count back to its original row
+        out = jnp.zeros((nl + nr,), jnp.int32).at[p_s].set(before)
+        return out[:nl]
+
+    hi = spans(1)                 # right first on ties: counts rank <= lrank
+    lo = spans(0)                 # left first on ties:  counts rank <  lrank
     n_valid = jnp.sum(rvalid.astype(jnp.int32))
-    lo = jnp.searchsorted(rsorted, lrank, side="left").astype(jnp.int32)
-    hi = jnp.searchsorted(rsorted, lrank, side="right").astype(jnp.int32)
-    hi = jnp.minimum(hi, n_valid)
+    hi = jnp.minimum(hi, n_valid)                    # exclude null-key rights
     lo = jnp.minimum(lo, hi)
     counts = jnp.where(lvalid, hi - lo, 0)
     return counts, lo, rorder
@@ -103,10 +124,12 @@ def _expand(counts, lo, rorder, *, total: int, outer: bool):
     nl = counts.shape[0]
     eff = jnp.maximum(counts, 1) if outer else counts
     starts = jnp.cumsum(eff) - eff            # exclusive scan
-    ends = starts + eff
+    # which left row produced output slot j: repeat row ids by their counts
+    # (jnp.repeat with a static total lowers to cumsum+scatter+max-scan —
+    # no per-slot binary search)
+    lsel = jnp.repeat(jnp.arange(nl, dtype=jnp.int32), eff,
+                      total_repeat_length=total)
     j = jnp.arange(total, dtype=jnp.int32)
-    # which left row produced output slot j
-    lsel = jnp.searchsorted(ends, j, side="right").astype(jnp.int32)
     k = j - jnp.take(starts, lsel, axis=0)
     matched = jnp.take(counts, lsel, axis=0) > 0
     if rorder.shape[0] == 0:                  # static shape: empty right side

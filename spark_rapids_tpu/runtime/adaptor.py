@@ -25,7 +25,6 @@ GpuRetryOOM/GpuSplitAndRetryOOM/CpuRetryOOM/CpuSplitAndRetryOOM classes).
 from __future__ import annotations
 
 import ctypes
-import os
 import threading
 import weakref
 from typing import Iterable, Optional
@@ -180,23 +179,26 @@ class ResourceArbiter:
     the deadlock watchdog daemon (100 ms cadence, like
     SparkResourceAdaptor.java:35-36)."""
 
-    WATCHDOG_PERIOD_S = float(os.environ.get("SPARK_RAPIDS_TPU_WATCHDOG_PERIOD_MS", "100")) / 1e3
 
     def __init__(self, log_loc: Optional[str] = None, watchdog: bool = True):
         self._lib = _native()
         self._h = self._lib.sra_create((log_loc or "").encode())
         if not self._h:
             raise ValueError(self._lib.sra_last_error().decode())
+        from ..config import retry_limit
+        self._lib.sra_set_retry_limit(self._h, retry_limit())
         self._closed = False
         self._close_lock = threading.Lock()
         self._watchdog_stop = threading.Event()
         self._watchdog = None
         if watchdog:
+            from ..config import watchdog_period_s
             # weakref target: a bound-method target would root the arbiter
             # and keep __del__ from ever firing
             self._watchdog = threading.Thread(
                 target=_watchdog_loop,
-                args=(weakref.ref(self), self._watchdog_stop, self.WATCHDOG_PERIOD_S),
+                args=(weakref.ref(self), self._watchdog_stop,
+                      watchdog_period_s()),
                 name="tpu-arbiter-watchdog", daemon=True)
             self._watchdog.start()
 

@@ -25,7 +25,8 @@ ENV_FLAG = "SPARK_RAPIDS_TPU_TRACE"
 
 
 def enabled() -> bool:
-    return os.environ.get(ENV_FLAG, "") == "1"
+    from ..config import trace_enabled
+    return trace_enabled()
 
 
 @contextlib.contextmanager

@@ -1,0 +1,115 @@
+"""Copying-op tests: concat/slice/split/replace_nulls/if_else/distinct
+(the cudf copying surface; split is the SplitAndRetry batch primitive —
+RmmSpark.java:461-490)."""
+import numpy as np
+import pytest
+
+import spark_rapids_tpu  # noqa: F401
+from spark_rapids_tpu import Column, Table, dtypes
+from spark_rapids_tpu.ops import (concat_columns, concat_tables,
+                                  drop_duplicates, halve_table, if_else,
+                                  replace_nulls, slice_table, split_table)
+
+
+def col(values, dtype=None, nulls=None):
+    c = Column.from_numpy(np.asarray(values, dtype=dtype))
+    if nulls is not None:
+        import jax.numpy as jnp
+        c = c.with_validity(jnp.asarray(~np.asarray(nulls)))
+    return c
+
+
+def scol(values):
+    return Column.from_pylist(values, dtypes.STRING)
+
+
+def test_concat_fixed_and_strings():
+    a = col([1, 2], np.int64, nulls=[False, True])
+    b = col([3], np.int64)
+    assert concat_columns([a, b]).to_pylist() == [1, None, 3]
+    s = concat_columns([scol(["x", None]), scol([""]), scol(["yz"])])
+    assert s.to_pylist() == ["x", None, "", "yz"]
+
+
+def test_concat_tables_and_dtype_mismatch():
+    t1 = Table([col([1], np.int64)], names=["a"])
+    t2 = Table([col([2], np.int64)], names=["a"])
+    assert concat_tables([t1, t2])["a"].to_pylist() == [1, 2]
+    with pytest.raises(TypeError):
+        concat_columns([col([1], np.int64), col([1.0], np.float64)])
+
+
+def test_slice_split_halve():
+    t = Table([col(np.arange(10), np.int64), scol([str(i) for i in range(10)])],
+              names=["x", "s"])
+    assert slice_table(t, 2, 5)["x"].to_pylist() == [2, 3, 4]
+    parts = split_table(t, [3, 7])
+    assert [p.num_rows for p in parts] == [3, 4, 3]
+    assert parts[1]["s"].to_pylist() == ["3", "4", "5", "6"]
+    halves = halve_table(t)
+    assert [h.num_rows for h in halves] == [5, 5]
+    # round trip: concat(split(t)) == t
+    back = concat_tables(parts)
+    assert back["x"].to_pylist() == t["x"].to_pylist()
+    assert back["s"].to_pylist() == t["s"].to_pylist()
+    with pytest.raises(ValueError):
+        split_table(t, [7, 3])
+
+
+def test_replace_nulls():
+    c = col([1, 0, 3], np.int64, nulls=[False, True, False])
+    out = replace_nulls(c, -1)
+    assert out.to_pylist() == [1, -1, 3] and out.validity is None
+    s = replace_nulls(scol(["ab", None, "c", None]), "N/A")
+    assert s.to_pylist() == ["ab", "N/A", "c", "N/A"]
+    plain = col([1, 2], np.int64)
+    assert replace_nulls(plain, 9) is plain
+
+
+def test_if_else_spark_null_predicate():
+    mask = col([True, False, True], nulls=[False, False, True])
+    lhs = col([1, 2, 3], np.int64)
+    rhs = col([10, 20, 30], np.int64)
+    out = if_else(mask, lhs, rhs)
+    # null predicate -> ELSE branch (Spark CASE WHEN)
+    assert out.to_pylist() == [1, 20, 30]
+
+
+def test_if_else_null_sides_and_strings():
+    mask = col([True, False])
+    lhs = scol(["yes", "yes"])
+    rhs = scol([None, "no"])
+    assert if_else(mask, lhs, rhs).to_pylist() == ["yes", "no"]
+    out = if_else(col([False, True]), scol(["a", "b"]), scol([None, "zz"]))
+    assert out.to_pylist() == [None, "b"]
+
+
+def test_drop_duplicates_keeps_first_in_row_order():
+    t = Table([col([3, 1, 3, 2, 1], np.int64),
+               scol(["a", "b", "c", "d", "e"])], names=["k", "v"])
+    out = drop_duplicates(t, ["k"])
+    # first occurrences: rows 0 (k=3), 1 (k=1), 3 (k=2), in original order
+    assert out["k"].to_pylist() == [3, 1, 2]
+    assert out["v"].to_pylist() == ["a", "b", "d"]
+
+
+def test_empty_inputs_everywhere():
+    # empty batches flow through groupby/join/distinct without crashing
+    from spark_rapids_tpu.ops import groupby_aggregate, inner_join
+    empty = Table([col([], np.int64), col([], np.int64)], names=["k", "v"])
+    g = groupby_aggregate(empty, ["k"], [("v", "sum")])
+    assert g.num_rows == 0
+    lmap, rmap = inner_join([empty["k"]], [empty["k"]])
+    assert lmap.length == 0 and rmap.length == 0
+    assert drop_duplicates(empty).num_rows == 0
+    assert concat_tables([empty, empty]).num_rows == 0
+    assert split_table(empty, []) [0].num_rows == 0
+
+
+def test_drop_duplicates_all_columns_with_nulls():
+    t = Table([col([1, 1, 1], np.int64, nulls=[False, False, False]),
+               col([5, 5, 6], np.int64, nulls=[True, True, False])],
+              names=["a", "b"])
+    out = drop_duplicates(t)
+    assert out.num_rows == 2
+    assert out["b"].to_pylist() == [None, 6]
