@@ -1,5 +1,7 @@
 from .shuffle import (partition_ids, build_partition_map, exchange,
                       repartition_table, make_mesh)
+from .relational import distributed_groupby, distributed_inner_join
 
 __all__ = ["partition_ids", "build_partition_map", "exchange",
-           "repartition_table", "make_mesh"]
+           "repartition_table", "make_mesh",
+           "distributed_groupby", "distributed_inner_join"]

@@ -1,0 +1,251 @@
+"""Distributed relational ops over the device mesh.
+
+The reference's distributed story is Spark's: the plugin partial-aggregates
+per task, shuffles by key hash (UCX), and final-aggregates (SURVEY.md §2.4).
+Here the same physical plan runs as ONE jitted SPMD program per op —
+`shard_map` over the mesh with the ICI all-to-all from shuffle.py in the
+middle, XLA static shapes throughout:
+
+    distributed_groupby:  local sorted partial agg (padded, key_cap groups)
+        → murmur-pmod partition of the group keys → all-to-all (capacity =
+        key_cap: a source sends ≤ key_cap groups total, so no bucket can
+        overflow) → local final merge agg.
+    distributed_inner_join: both sides hash-partitioned by key → all-to-all
+        (slack-sized buckets, like shuffle.repartition_table) → shard-local
+        sort-merge join into a fixed row_cap output.
+
+Every stage reports overflow instead of corrupting: the returned flag is
+the SplitAndRetry signal (retry with bigger caps / smaller batch), the same
+detect-then-retry contract as the arbiter (SURVEY.md §5).
+
+Everything is device-resident end to end; the only host interaction is the
+caller-supplied static capacities, exactly like exchange()'s slack model.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .shuffle import build_partition_map, partition_ids
+
+_AGGS = ("sum", "count", "min", "max")
+
+# key int64.max is the dead-slot sentinel throughout (padded all-to-all
+# slots); a real key with that exact value would merge with padding
+_DEAD_KEY = jnp.iinfo(jnp.int64).max
+
+
+def _spark_murmur_i64(keys: jnp.ndarray) -> jnp.ndarray:
+    """Spark murmur3_32 (seed 42, like GpuHashPartitioning) of int64 keys."""
+    from ..ops.hash import murmur_hash3_32
+    from ..columnar import Column, Table
+    from .. import dtypes
+    col = Column(dtype=dtypes.INT64, length=keys.shape[0],
+                 data=keys.astype(jnp.int64))
+    return murmur_hash3_32(Table([col]), seed=42).data
+
+
+def _fit(x: jnp.ndarray, cap: int, fill) -> jnp.ndarray:
+    """Slice or pad a (n,) array to exactly (cap,)."""
+    n = x.shape[0]
+    if n >= cap:
+        return x[:cap]
+    return jnp.concatenate([x, jnp.full((cap - n,), fill, x.dtype)])
+
+
+def _identity(op: str) -> int:
+    info = jnp.iinfo(jnp.int64)
+    return {"sum": 0, "min": info.max, "max": info.min}[op]
+
+
+def _merge_groups(keys: jnp.ndarray, alive: jnp.ndarray,
+                  cols: Sequence[Tuple[jnp.ndarray, str]], key_cap: int):
+    """Shard-local merge of rows with equal keys (the shared kernel behind
+    both the partial and final stages; same sorted-span machinery as
+    ops/aggregate.py's scatter-free groupby).
+
+    cols: [(int64 column, merge op in sum|min|max)]. Dead rows (alive False)
+    are excluded. Returns (keys (key_cap,), outs [(key_cap,)], valid
+    (key_cap,), n_real_groups) — padded/sliced to exactly key_cap.
+    """
+    n = keys.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    k = jnp.where(alive, keys, _DEAD_KEY)     # dead rows sort last
+    sk, order = jax.lax.sort([k, iota], num_keys=1, is_stable=True)
+    salive = jnp.take(alive, order, axis=0)
+
+    neq = sk != jnp.roll(sk, 1)
+    boundary = neq.at[0].set(True) if n else neq
+    gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    # boundary-compaction sort for group starts (see ops/aggregate.py)
+    flag = jnp.where(boundary, jnp.int32(0), jnp.int32(1))
+    payload = jnp.where(boundary, iota, jnp.int32(n))
+    starts = jax.lax.sort([flag, payload], num_keys=1, is_stable=True)[1]
+    if n:
+        ends = jnp.concatenate([starts[1:], jnp.full((1,), n, jnp.int32)])
+    else:
+        ends = starts
+    last = jnp.clip(ends - 1, 0, max(n - 1, 0))
+    prev = starts - 1
+
+    def span_sum(x):
+        c = jnp.cumsum(x)
+        hi = jnp.take(c, last, axis=0)
+        lo = jnp.where(prev >= 0, jnp.take(c, jnp.maximum(prev, 0), axis=0), 0)
+        return hi - lo
+
+    alive_cnt = span_sum(salive.astype(jnp.int32))
+    outs: List[jnp.ndarray] = []
+    for col, op in cols:
+        sc = jnp.take(col, order, axis=0)
+        if op == "sum":
+            outs.append(span_sum(jnp.where(salive, sc.astype(jnp.int64), 0)))
+        else:
+            ident = jnp.int64(_identity(op))
+            masked = jnp.where(salive, sc.astype(jnp.int64), ident)
+
+            def combine(a, b, op=op):
+                ab, av = a
+                bb, bv = b
+                m = jnp.minimum(av, bv) if op == "min" else jnp.maximum(av, bv)
+                return ab | bb, jnp.where(bb, bv, m)
+            _, res = jax.lax.associative_scan(combine, (boundary, masked))
+            outs.append(jnp.take(res, last, axis=0))
+
+    n_groups = (gid[-1] + 1) if n else jnp.int32(0)
+    # real groups only: the dead-key sentinel group (if any padding existed)
+    # sorts last and has alive_cnt == 0 — it must not trip overflow
+    in_range = iota < n_groups
+    n_real = jnp.sum((alive_cnt > 0) & in_range).astype(jnp.int32)
+
+    gkeys = jnp.take(sk, starts, axis=0, mode="clip")
+    valid = (_fit(alive_cnt, key_cap, 0) > 0) & \
+        (jnp.arange(key_cap, dtype=jnp.int32) < n_groups)
+    return (_fit(gkeys, key_cap, _DEAD_KEY),
+            [_fit(o, key_cap, 0) for o in outs],
+            valid, n_real)
+
+
+def distributed_groupby(mesh: Mesh, keys: jnp.ndarray, vals: jnp.ndarray,
+                        aggs: Sequence[str], key_cap: int,
+                        axis: str = "data"):
+    """Groupby over mesh-sharded int64 key/value columns — ONE jitted SPMD
+    program (partial agg → ICI all-to-all by key hash → final agg).
+
+    `key_cap` bounds the distinct keys per shard at both stages (static
+    shapes); the returned per-shard `overflow` flag means results are
+    incomplete — retry with a bigger key_cap (SplitAndRetry contract).
+    Returns per-shard padded (keys, [agg arrays], valid, overflow)."""
+    for a in aggs:
+        if a not in _AGGS:
+            raise ValueError(f"unsupported distributed agg {a!r}")
+    n_peers = mesh.shape[axis]
+    aggs = tuple(aggs)
+
+    def partial_cols(vals, alive):
+        ones = jnp.ones(vals.shape, jnp.int64)
+        return [(ones if a == "count" else vals,
+                 "sum" if a in ("sum", "count") else a) for a in aggs]
+
+    def merge_cols(partials):
+        return [(p, "sum" if a in ("sum", "count") else a)
+                for p, a in zip(partials, aggs)]
+
+    def local(keys, vals):
+        alive = jnp.ones(keys.shape, bool)
+        gk, partials, gvalid, n_real = _merge_groups(
+            keys, alive, partial_cols(vals, alive), key_cap)
+        overflow = n_real > key_cap
+
+        # route each surviving group to its owner peer; dead slots to the
+        # out-of-range partition so they never land in a bucket
+        part = partition_ids(_spark_murmur_i64(gk), n_peers)
+        part = jnp.where(gvalid, part, jnp.int32(n_peers))
+        gather_idx, bvalid, _ = build_partition_map(part, n_peers, key_cap)
+
+        def bucket(x, fill):
+            b = jnp.take(x, gather_idx, axis=0)          # (peers, cap)
+            return jnp.where(bvalid, b, fill)
+
+        recv_k = jax.lax.all_to_all(bucket(gk, _DEAD_KEY), axis, 0, 0,
+                                    tiled=True).reshape(-1)
+        recv_alive = jax.lax.all_to_all(bucket(gvalid, False), axis, 0, 0,
+                                        tiled=True).reshape(-1)
+        recv_p = [jax.lax.all_to_all(
+            bucket(p, jnp.int64(_identity(op))), axis, 0, 0,
+            tiled=True).reshape(-1) for p, op in merge_cols(partials)]
+
+        fk, fouts, fvalid, fn_real = _merge_groups(
+            recv_k, recv_alive, merge_cols(recv_p), key_cap)
+        overflow = overflow | (fn_real > key_cap)
+        return fk, tuple(fouts), fvalid, overflow.reshape(1)  # rank-1 spec
+
+    spec = P(axis)
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec),
+                   out_specs=(spec, tuple(spec for _ in aggs), spec, spec))
+    return fn(keys, vals)
+
+
+def distributed_inner_join(mesh: Mesh, lkeys: jnp.ndarray, lvals: jnp.ndarray,
+                           rkeys: jnp.ndarray, rvals: jnp.ndarray,
+                           row_cap: int, slack: float = 2.0,
+                           axis: str = "data"):
+    """Inner equi-join of two mesh-sharded int64-keyed tables — one jitted
+    SPMD program: hash-partition both sides (slack-sized buckets, NOT the
+    whole table per shard), all-to-all, shard-local sort-merge join into a
+    fixed row_cap output.
+
+    Returns per-shard padded (lkey, lval, rval, valid, overflow); overflow
+    covers both bucket spill during the shuffle and join-output spill past
+    row_cap — retry with bigger slack/row_cap (SplitAndRetry contract)."""
+    n_peers = mesh.shape[axis]
+
+    def local(lk, lv, rk, rv):
+        def reshuffle(keys, vals):
+            nloc = keys.shape[0]
+            cap = max(1, math.ceil(nloc / n_peers * slack))
+            part = partition_ids(_spark_murmur_i64(keys), n_peers)
+            gi, bvalid, counts = build_partition_map(part, n_peers, cap)
+            spilled = jnp.any(counts > cap)
+            bk = jnp.where(bvalid, jnp.take(keys, gi, axis=0), _DEAD_KEY)
+            bv_ = jnp.where(bvalid, jnp.take(vals, gi, axis=0), 0)
+            rk_ = jax.lax.all_to_all(bk, axis, 0, 0, tiled=True).reshape(-1)
+            rv_ = jax.lax.all_to_all(bv_, axis, 0, 0, tiled=True).reshape(-1)
+            ralive = jax.lax.all_to_all(bvalid, axis, 0, 0,
+                                        tiled=True).reshape(-1)
+            return rk_, rv_, ralive, spilled
+
+        Lk, Lv, Lalive, lspill = reshuffle(lk, lv)
+        Rk, Rv, Ralive, rspill = reshuffle(rk, rv)
+
+        # shard-local join via union rank + sort-merge spans (ops/join.py
+        # machinery, shard-local shapes)
+        from ..ops.join import _match_spans, _union_ranks
+        nl, nr = Lk.shape[0], Rk.shape[0]
+        ranks = _union_ranks((jnp.concatenate([Lk, Rk]),), n_ops=1)
+        counts, lo, rorder = _match_spans(ranks[:nl], Lalive,
+                                          ranks[nl:], Ralive)
+        starts = jnp.cumsum(counts) - counts
+        lsel = jnp.repeat(jnp.arange(nl, dtype=jnp.int32), counts,
+                          total_repeat_length=row_cap)
+        j = jnp.arange(row_cap, dtype=jnp.int32)
+        total = jnp.sum(counts)
+        live = j < total
+        k = j - jnp.take(starts, lsel, axis=0)
+        rpos = jnp.take(lo, lsel, axis=0) + k
+        rsel = jnp.take(rorder, jnp.clip(rpos, 0, max(nr - 1, 0)), axis=0)
+        out_lk = jnp.where(live, jnp.take(Lk, lsel, axis=0), 0)
+        out_lv = jnp.where(live, jnp.take(Lv, lsel, axis=0), 0)
+        out_rv = jnp.where(live, jnp.take(Rv, rsel, axis=0), 0)
+        overflow = (total > row_cap) | lspill | rspill
+        return out_lk, out_lv, out_rv, live, overflow.reshape(1)
+
+    spec = P(axis)
+    fn = shard_map(local, mesh=mesh, in_specs=(spec,) * 4,
+                   out_specs=(spec,) * 5)
+    return fn(lkeys, lvals, rkeys, rvals)
