@@ -63,6 +63,30 @@ def _identity(op: str) -> int:
     return {"sum": 0, "min": info.max, "max": info.min}[op]
 
 
+def _bucket_exchange(axis: str, n_peers: int, cap: int, part: jnp.ndarray,
+                     payloads: Sequence[Tuple[jnp.ndarray, object]]):
+    """Shared bucket-then-all-to-all body (the shape of shuffle.py's
+    _exchange_local): bucket rows by `part` into (n_peers, cap) slots, ship
+    each bucket to its peer, and — like _exchange_local — ship only the (P,)
+    sent counts and rebuild the validity mask receiver-side (capacity× less
+    ICI traffic than a full bool mask).
+
+    payloads: [(array, dead-slot fill)]. Returns (received arrays (P*cap,),
+    recv_valid (P*cap,), spilled scalar bool)."""
+    gi, bvalid, counts = build_partition_map(part, n_peers, cap)
+    spilled = jnp.any(counts > cap)
+    outs = []
+    for x, fill in payloads:
+        b = jnp.where(bvalid, jnp.take(x, gi, axis=0),
+                      jnp.asarray(fill, x.dtype))
+        outs.append(jax.lax.all_to_all(b, axis, 0, 0, tiled=True).reshape(-1))
+    sent = jnp.minimum(counts, cap)
+    sent_recv = jax.lax.all_to_all(sent, axis, 0, 0, tiled=True)
+    slot = jnp.arange(cap, dtype=jnp.int32)[None, :]
+    recv_valid = (slot < sent_recv[:, None]).reshape(-1)
+    return outs, recv_valid, spilled
+
+
 def _merge_groups(keys: jnp.ndarray, alive: jnp.ndarray,
                   cols: Sequence[Tuple[jnp.ndarray, str]], key_cap: int):
     """Shard-local merge of rows with equal keys (the shared kernel behind
@@ -166,19 +190,10 @@ def distributed_groupby(mesh: Mesh, keys: jnp.ndarray, vals: jnp.ndarray,
         # out-of-range partition so they never land in a bucket
         part = partition_ids(_spark_murmur_i64(gk), n_peers)
         part = jnp.where(gvalid, part, jnp.int32(n_peers))
-        gather_idx, bvalid, _ = build_partition_map(part, n_peers, key_cap)
-
-        def bucket(x, fill):
-            b = jnp.take(x, gather_idx, axis=0)          # (peers, cap)
-            return jnp.where(bvalid, b, fill)
-
-        recv_k = jax.lax.all_to_all(bucket(gk, _DEAD_KEY), axis, 0, 0,
-                                    tiled=True).reshape(-1)
-        recv_alive = jax.lax.all_to_all(bucket(gvalid, False), axis, 0, 0,
-                                        tiled=True).reshape(-1)
-        recv_p = [jax.lax.all_to_all(
-            bucket(p, jnp.int64(_identity(op))), axis, 0, 0,
-            tiled=True).reshape(-1) for p, op in merge_cols(partials)]
+        (recv_k, *recv_p), recv_alive, _ = _bucket_exchange(
+            axis, n_peers, key_cap, part,
+            [(gk, _DEAD_KEY)] + [(p, _identity(op))
+                                 for p, op in merge_cols(partials)])
 
         fk, fouts, fvalid, fn_real = _merge_groups(
             recv_k, recv_alive, merge_cols(recv_p), key_cap)
@@ -188,6 +203,62 @@ def distributed_groupby(mesh: Mesh, keys: jnp.ndarray, vals: jnp.ndarray,
     spec = P(axis)
     fn = shard_map(local, mesh=mesh, in_specs=(spec, spec),
                    out_specs=(spec, tuple(spec for _ in aggs), spec, spec))
+    return fn(keys, vals)
+
+
+def distributed_sort(mesh: Mesh, keys: jnp.ndarray, vals: jnp.ndarray,
+                     slack: float = 2.0, axis: str = "data"):
+    """Global sort of mesh-sharded (key, value) columns — sample-sort as one
+    jitted SPMD program. This is the scale-past-one-device primitive (a
+    "sequence" longer than any single chip's memory): shard 0 ends with the
+    smallest keys, shard P-1 the largest, each locally sorted.
+
+    1. each shard samples P-1 local quantile keys from its sorted run
+    2. all_gather the samples; global splitters = quantiles of the pool
+    3. bucket rows by splitter interval; ICI all-to-all (slack-sized)
+    4. local sort of the received rows
+
+    Returns per-shard (keys, vals, valid, overflow); overflow means a shard
+    received more than cap rows (skewed keys) — retry with bigger slack."""
+    n_peers = mesh.shape[axis]
+
+    def local(k, v):
+        nloc = k.shape[0]
+        # per-destination bucket capacity: splitters balance destinations to
+        # ~nloc/P rows each; slack absorbs sampling error and key skew
+        cap = max(1, math.ceil(nloc / n_peers * slack))
+        sk, order = jax.lax.sort([k, jnp.arange(nloc, dtype=jnp.int32)],
+                                 num_keys=1, is_stable=True)
+        sv = jnp.take(v, order, axis=0)
+        # P-1 evenly spaced local samples of the sorted run
+        pos = (jnp.arange(1, n_peers, dtype=jnp.int32) * nloc) // n_peers
+        samples = jnp.take(sk, pos, axis=0, mode="clip")
+        pool = jax.lax.all_gather(samples, axis).reshape(-1)    # (P*(P-1),)
+        pool = jax.lax.sort([pool], num_keys=1)[0]
+        m = pool.shape[0]
+        spl_pos = (jnp.arange(1, n_peers, dtype=jnp.int32) * m) // n_peers
+        splitters = jnp.take(pool, spl_pos, axis=0, mode="clip")  # (P-1,)
+
+        # partition id = number of splitters < key (rows sorted, so the
+        # comparison is a tiny (n, P-1) broadcast, not a search)
+        part = jnp.sum(sk[:, None] > splitters[None, :],
+                       axis=1).astype(jnp.int32)
+        (rk, rv), ralive, spilled = _bucket_exchange(
+            axis, n_peers, cap, part, [(sk, _DEAD_KEY), (sv, 0)])
+        # a spill anywhere means some shard's output is incomplete: agree on
+        # the flag across the mesh so every caller sees it
+        spilled = jax.lax.all_gather(spilled.reshape(1), axis).any()
+
+        # final local sort; dead slots carry the sentinel and sink to the end
+        key2 = jnp.where(ralive, rk, _DEAD_KEY)
+        ok, oa, ov = jax.lax.sort(
+            [key2, jnp.where(ralive, jnp.int32(0), jnp.int32(1)), rv],
+            num_keys=2, is_stable=True)
+        return ok, ov, oa == 0, spilled.reshape(1)
+
+    spec = P(axis)
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec),
+                   out_specs=(spec,) * 4)
     return fn(keys, vals)
 
 
@@ -210,35 +281,23 @@ def distributed_inner_join(mesh: Mesh, lkeys: jnp.ndarray, lvals: jnp.ndarray,
             nloc = keys.shape[0]
             cap = max(1, math.ceil(nloc / n_peers * slack))
             part = partition_ids(_spark_murmur_i64(keys), n_peers)
-            gi, bvalid, counts = build_partition_map(part, n_peers, cap)
-            spilled = jnp.any(counts > cap)
-            bk = jnp.where(bvalid, jnp.take(keys, gi, axis=0), _DEAD_KEY)
-            bv_ = jnp.where(bvalid, jnp.take(vals, gi, axis=0), 0)
-            rk_ = jax.lax.all_to_all(bk, axis, 0, 0, tiled=True).reshape(-1)
-            rv_ = jax.lax.all_to_all(bv_, axis, 0, 0, tiled=True).reshape(-1)
-            ralive = jax.lax.all_to_all(bvalid, axis, 0, 0,
-                                        tiled=True).reshape(-1)
+            (rk_, rv_), ralive, spilled = _bucket_exchange(
+                axis, n_peers, cap, part, [(keys, _DEAD_KEY), (vals, 0)])
             return rk_, rv_, ralive, spilled
 
         Lk, Lv, Lalive, lspill = reshuffle(lk, lv)
         Rk, Rv, Ralive, rspill = reshuffle(rk, rv)
 
-        # shard-local join via union rank + sort-merge spans (ops/join.py
-        # machinery, shard-local shapes)
-        from ..ops.join import _match_spans, _union_ranks
-        nl, nr = Lk.shape[0], Rk.shape[0]
+        # shard-local join via union rank + sort-merge spans + padded
+        # expansion (ops/join.py machinery, shard-local shapes)
+        from ..ops.join import _expand, _match_spans, _union_ranks
+        nl = Lk.shape[0]
         ranks = _union_ranks((jnp.concatenate([Lk, Rk]),), n_ops=1)
         counts, lo, rorder = _match_spans(ranks[:nl], Lalive,
                                           ranks[nl:], Ralive)
-        starts = jnp.cumsum(counts) - counts
-        lsel = jnp.repeat(jnp.arange(nl, dtype=jnp.int32), counts,
-                          total_repeat_length=row_cap)
-        j = jnp.arange(row_cap, dtype=jnp.int32)
+        lsel, rsel = _expand(counts, lo, rorder, total=row_cap, outer=False)
         total = jnp.sum(counts)
-        live = j < total
-        k = j - jnp.take(starts, lsel, axis=0)
-        rpos = jnp.take(lo, lsel, axis=0) + k
-        rsel = jnp.take(rorder, jnp.clip(rpos, 0, max(nr - 1, 0)), axis=0)
+        live = jnp.arange(row_cap, dtype=jnp.int32) < total
         out_lk = jnp.where(live, jnp.take(Lk, lsel, axis=0), 0)
         out_lv = jnp.where(live, jnp.take(Lv, lsel, axis=0), 0)
         out_rv = jnp.where(live, jnp.take(Rv, rsel, axis=0), 0)

@@ -105,6 +105,33 @@ def test_exact_capacity_no_false_overflow():
         assert len(got) < 8
 
 
+def test_distributed_sort_global_order():
+    from spark_rapids_tpu.parallel import distributed_sort
+    mesh = _mesh()
+    rng = np.random.default_rng(5)
+    n = 8 * 256
+    keys = rng.integers(-10**9, 10**9, n).astype(np.int64)
+    vals = np.arange(n, dtype=np.int64)
+
+    ok, ov, valid, overflow = distributed_sort(
+        mesh, _shard(mesh, keys), _shard(mesh, vals), slack=3.0)
+    assert not bool(np.asarray(overflow).any())
+    k = np.asarray(ok)
+    v = np.asarray(ov)
+    m = np.asarray(valid)
+    # concatenating the shards' live rows in mesh order = global sorted order
+    got_keys = k[m]
+    assert got_keys.tolist() == sorted(keys.tolist())
+    # payload rows traveled with their keys
+    assert (keys[v[m]] == got_keys).all()
+    # per-shard chunks are contiguous key ranges (shard i max <= shard i+1 min)
+    chunks = [k[i * len(k) // 8:(i + 1) * len(k) // 8][
+        m[i * len(k) // 8:(i + 1) * len(k) // 8]] for i in range(8)]
+    for a, b in zip(chunks, chunks[1:]):
+        if len(a) and len(b):
+            assert a.max() <= b.min()
+
+
 def test_distributed_inner_join_matches_local():
     mesh = _mesh()
     rng = np.random.default_rng(1)
