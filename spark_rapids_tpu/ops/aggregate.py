@@ -31,7 +31,7 @@ from .. import dtypes
 from ..columnar import Column, Table
 from ..dtypes import Kind
 from .gather import take
-from .sort import _key_operands
+from .sort import NULLS_LAST, _key_operands
 
 AGG_OPS = ("sum", "count", "min", "max", "mean", "size")
 
@@ -191,7 +191,8 @@ def groupby_aggregate(table: Table,
     agg_datas: List = []
     agg_valids: List = []
     agg_kinds: List[str] = []
-    for col_ref, op in aggs:
+    string_extremes: List[Tuple] = []       # (agg idx, col, col_ref, op)
+    for i, (col_ref, op) in enumerate(aggs):
         if op not in AGG_OPS:
             raise ValueError(f"unknown aggregation {op!r}")
         if op in ("size", "count"):
@@ -199,6 +200,17 @@ def groupby_aggregate(table: Table,
             c = keys[0] if op == "size" else table[col_ref]
             agg_datas.append(jnp.zeros((n,), jnp.int8))
             agg_valids.append(None if op == "size" else c.validity)
+        elif op in ("min", "max") and table[col_ref].dtype.is_string:
+            # strings: resolved by an extra value-ordered sort (below); the
+            # kernel carries a placeholder so outputs stay index-aligned.
+            # A column's first slot carries the per-group non-null count
+            # (locates max when one shared asc sort serves both extremes).
+            first_for_col = col_ref not in [r for _, _, r, _ in string_extremes]
+            string_extremes.append((i, table[col_ref], col_ref, op))
+            agg_datas.append(jnp.zeros((n,), jnp.int8))
+            agg_valids.append(table[col_ref].validity if first_for_col else None)
+            agg_kinds.append("count" if first_for_col else "size")
+            continue
         else:
             c = table[col_ref]
             if not (c.dtype.is_integer or c.dtype.is_floating
@@ -220,7 +232,50 @@ def groupby_aggregate(table: Table,
     out_cols = [take(c, first_rows, _has_negative=False) for c in keys]
     names = [table.names[k] if isinstance(k, int) else k for k in key_names]
 
-    for (data, valid), (col_ref, op) in zip(outs, aggs):
+    # string min/max: ONE extra value-ordered sort per string column. With
+    # ascending NULLS_LAST order, each group's min sits at its first sorted
+    # row and its max at (start + non-null count - 1); a max-only column
+    # sorts descending so its extreme also sits at the start. take()
+    # propagates the gathered row's validity, so an all-null group (whose
+    # extreme row is null under NULLS_LAST) comes out null — Spark semantics.
+    string_results = {}
+    by_col = {}
+    for agg_idx, c, ref, op in string_extremes:
+        by_col.setdefault(ref, {"col": c, "ops": [], "cnt_idx": None})
+        by_col[ref]["ops"].append((agg_idx, op))
+        if by_col[ref]["cnt_idx"] is None:
+            by_col[ref]["cnt_idx"] = agg_idx        # first slot carries count
+    for ref, info in by_col.items():
+        c = info["col"]
+        wants = {op for _, op in info["ops"]}
+        ascending = "min" in wants                  # max-only sorts desc
+        vops = _key_operands(c, ascending, NULLS_LAST)
+        srt = jax.lax.sort([*operands, *vops,
+                            jnp.arange(n, dtype=jnp.int32)],
+                           num_keys=len(operands) + len(vops), is_stable=True)
+        order2 = srt[-1]
+        starts = first_sorted[:g]
+        at_start = take(c, jnp.take(order2, starts, axis=0),
+                        _has_negative=False)
+        at_last = None
+        if wants == {"min", "max"}:
+            cnt = outs[info["cnt_idx"]][0][:g]       # per-group non-null count
+            last_pos = starts + jnp.maximum(cnt, 1).astype(jnp.int32) - 1
+            at_last = take(c, jnp.take(order2, last_pos, axis=0),
+                           _has_negative=False)
+        for agg_idx, op in info["ops"]:
+            if op == "min" or wants != {"min", "max"}:
+                string_results[agg_idx] = at_start
+            else:
+                string_results[agg_idx] = at_last
+
+    for i, ((data, valid), (col_ref, op)) in enumerate(zip(outs, aggs)):
+        cname = (col_ref if isinstance(col_ref, str)
+                 else table.names[col_ref]) if op != "size" else "*"
+        if i in string_results:
+            out_cols.append(string_results[i])
+            names.append(f"{op}({cname})")
+            continue
         src_dt = dtypes.INT64 if op == "size" else table[col_ref].dtype
         dt = _agg_value_dtype(op, src_dt)
         d = data[:g]
@@ -229,8 +284,6 @@ def groupby_aggregate(table: Table,
         v = None if valid is None else valid[:g]
         out_cols.append(Column(dtype=dt, length=g,
                                data=d.astype(dt.storage_dtype()), validity=v))
-        cname = (col_ref if isinstance(col_ref, str)
-                 else table.names[col_ref]) if op != "size" else "*"
         names.append(f"{op}({cname})")
 
     return Table(out_cols, names)

@@ -77,7 +77,8 @@ def _key_operands(col: Column, ascending: bool, null_precedence: Optional[str]):
         pad4 = (-L) % 4
         if pad4:
             padded = jnp.pad(padded, ((0, 0), (0, pad4)))
-        words = padded.reshape(n, -1, 4).astype(jnp.uint32)
+        # explicit word count, not -1: reshape(-1) divides by zero on n == 0
+        words = padded.reshape(n, (L + pad4) // 4, 4).astype(jnp.uint32)
         # big-endian packing: first byte most significant
         w = ((words[:, :, 0] << 24) | (words[:, :, 1] << 16)
              | (words[:, :, 2] << 8) | words[:, :, 3])

@@ -162,6 +162,46 @@ def test_groupby_random_against_pandas():
     assert np.allclose(out["mean(f)"].to_pylist(), ref["m"].tolist())
 
 
+def test_groupby_string_min_max():
+    k = col([1, 1, 1, 2, 2, 3], np.int32)
+    s = scol(["pear", "apple", None, "b", "a", None])
+    out = groupby_aggregate(Table([k, s], names=["k", "s"]), ["k"],
+                            [("s", "min"), ("s", "max"), ("s", "count")])
+    # min/max ignore nulls; an all-null group yields null
+    assert out["min(s)"].to_pylist() == ["apple", "a", None]
+    assert out["max(s)"].to_pylist() == ["pear", "b", None]
+    assert out["count(s)"].to_pylist() == [2, 2, 0]
+
+
+def test_groupby_string_min_max_against_pandas():
+    rng = np.random.default_rng(4)
+    n = 5000
+    k = rng.integers(0, 40, n).astype(np.int32)
+    words = np.array(["kiwi", "fig", "apple", "banana", "cherry", "date",
+                      "elderberry", "grape"])
+    s = words[rng.integers(0, len(words), n)]
+    t = Table([col(k), scol(list(s))], names=["k", "s"])
+    out = groupby_aggregate(t, ["k"], [("s", "min"), ("s", "max")])
+    df = pd.DataFrame({"k": k, "s": s})
+    ref = df.groupby("k", sort=True).agg(mn=("s", "min"),
+                                         mx=("s", "max")).reset_index()
+    assert out["min(s)"].to_pylist() == ref["mn"].tolist()
+    assert out["max(s)"].to_pylist() == ref["mx"].tolist()
+
+
+def test_groupby_string_min_max_empty_table():
+    t = Table([col([], np.int32), scol([])], names=["k", "s"])
+    out = groupby_aggregate(t, ["k"], [("s", "min"), ("s", "max")])
+    assert out.num_rows == 0
+    assert out["min(s)"].to_pylist() == []
+
+
+def test_sort_empty_string_keys():
+    t = Table([scol([])], names=["s"])
+    from spark_rapids_tpu.ops import sort_table
+    assert sort_table(t, ["s"]).num_rows == 0
+
+
 def test_groupby_int_sum_wraps_like_java_long():
     k = col([7, 7], np.int32)
     v = col([2**63 - 1, 1], np.int64)
