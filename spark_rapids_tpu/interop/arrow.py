@@ -31,6 +31,25 @@ def _col_to_arrow(col: Column) -> pa.Array:
     import jax.numpy as jnp  # noqa: F401
 
     n = col.length
+    k = col.dtype.kind
+    # nested types take a pyarrow is-null mask, not a packed bitmap: handle
+    # them before the (otherwise wasted) packbits pass below
+    if k in (Kind.STRUCT, Kind.LIST):
+        mask = (pa.array(~np.asarray(col.validity))
+                if col.validity is not None else None)
+        if k == Kind.STRUCT:
+            children = [_col_to_arrow(c) for c in col.children]
+            names = list(col.dtype.field_names or
+                         [str(i) for i in range(len(children))])
+            return pa.StructArray.from_arrays(children, names=names,
+                                              mask=mask)
+        child = _col_to_arrow(col.children[0])
+        offsets = pa.array(np.asarray(col.offsets, dtype=np.int32),
+                           type=pa.int32())
+        # mask kwarg, NOT null offset slots: masking an offset slot erases a
+        # row boundary and the preceding row absorbs the null row's extent
+        return pa.ListArray.from_arrays(offsets, child, mask=mask)
+
     if col.validity is not None:
         is_valid = np.asarray(col.validity)
         null_count = int(n - is_valid.sum())
@@ -39,7 +58,6 @@ def _col_to_arrow(col: Column) -> pa.Array:
         null_count = 0
         vbuf = None
 
-    k = col.dtype.kind
     if k == Kind.STRING:
         chars = np.asarray(col.data, dtype=np.uint8)
         offsets = np.asarray(col.offsets, dtype=np.int32)
@@ -115,6 +133,28 @@ def _col_from_arrow(arr: pa.ChunkedArray | pa.Array, name: str) -> Column:
                       data=jnp.asarray(chars),
                       offsets=jnp.asarray((off - base).astype(np.int32)),
                       validity=validity)
+    if pa.types.is_struct(t):
+        names = [f.name for f in t]
+        children = tuple(_col_from_arrow(arr.field(i), f.name)
+                         for i, f in enumerate(t))
+        # build the Column directly: make_struct's **fields kwargs would
+        # collide with a field literally named "validity", and a zero-field
+        # struct still carries its own row count
+        dt = dtypes.DType(Kind.STRUCT, children=tuple(c.dtype for c in children),
+                          field_names=tuple(names))
+        return Column(dtype=dt, length=n, validity=validity,
+                      children=children)
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        if pa.types.is_large_list(t):
+            arr = arr.cast(pa.list_(t.value_type))
+            t = arr.type
+        # normalize nulls/offset slicing: arrow allows null offset slots and
+        # array offsets; rebuild dense offsets from flattened lengths
+        lens = np.asarray(arr.value_lengths().fill_null(0))
+        offsets = np.zeros(n + 1, np.int32)
+        np.cumsum(lens, out=offsets[1:])
+        child = _col_from_arrow(arr.flatten(), name + ".item")
+        return Column.make_list(jnp.asarray(offsets), child, validity)
     if pa.types.is_decimal256(t):
         raise TypeError(f"decimal256 import unsupported for column {name!r}; "
                         "cast to decimal128 first")

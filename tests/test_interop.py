@@ -114,6 +114,60 @@ def test_apply_boolean_mask_rejects_wrong_length():
     assert out.to_pylist() == [0, 2, 4]
 
 
+def test_nested_list_struct_round_trip():
+    import jax.numpy as jnp
+    # build LIST<STRUCT<key:str, value:str>> — the from_json output shape
+    keys = Column.from_pylist(["a", "b", "c"], dtypes.STRING)
+    vals = Column.from_pylist(["1", None, "3"], dtypes.STRING)
+    struct = Column.make_struct(key=keys, value=vals)
+    offsets = jnp.asarray(np.array([0, 2, 2, 3], np.int32))
+    lists = Column.make_list(offsets, struct,
+                             jnp.asarray([True, False, True]))
+    t = Table([lists], names=["m"])
+    at = to_arrow(t)
+    assert at.column("m").to_pylist() == [
+        [{"key": "a", "value": "1"}, {"key": "b", "value": None}],
+        None,
+        [{"key": "c", "value": "3"}],
+    ]
+    back = from_arrow(at)
+    assert back["m"].to_pylist() == t["m"].to_pylist()
+
+
+def test_null_list_with_nonempty_extent_does_not_corrupt_neighbor():
+    import jax.numpy as jnp
+    child = Column.from_numpy(np.array([1, 2, 3, 4], np.int64))
+    # null row 1 spans [2,3): its extent must NOT leak into row 0
+    lists = Column.make_list(jnp.asarray(np.array([0, 2, 3, 4], np.int32)),
+                             child, jnp.asarray([True, False, True]))
+    at = to_arrow(Table([lists], names=["l"]))
+    assert at.column("l").to_pylist() == [[1, 2], None, [4]]
+
+
+def test_struct_field_named_validity_imports():
+    at = pa.table({"s": pa.array([{"validity": 1}, {"validity": 2}])})
+    t = from_arrow(at)
+    assert t["s"].to_pylist() == [{"validity": 1}, {"validity": 2}]
+
+
+def test_zero_field_struct_imports():
+    at = pa.table({"s": pa.array([{}, {}], type=pa.struct([]))})
+    t = from_arrow(at)
+    assert t["s"].length == 2
+
+
+def test_from_json_output_exports_to_arrow():
+    from spark_rapids_tpu.ops import from_json
+    col = Column.from_pylist(['{"x": 1, "y": "two"}', None, "{}"],
+                             dtypes.STRING)
+    m = from_json(col)
+    at = to_arrow(Table([m], names=["m"]))
+    got = at.column("m").to_pylist()
+    assert got[0] == [{"key": "x", "value": "1"},
+                      {"key": "y", "value": "two"}]
+    assert got[2] == []
+
+
 def test_from_arrow_date_timestamp():
     import datetime
     at = pa.table({
