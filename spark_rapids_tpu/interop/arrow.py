@@ -41,6 +41,12 @@ def _col_to_arrow(col: Column) -> pa.Array:
             children = [_col_to_arrow(c) for c in col.children]
             names = list(col.dtype.field_names or
                          [str(i) for i in range(len(children))])
+            if not children:
+                # from_arrays([]) infers length 0 and would drop every row
+                is_valid = (np.asarray(col.validity) if col.validity is not None
+                            else np.ones(n, dtype=bool))
+                return pa.array([{} if v else None for v in is_valid],
+                                type=pa.struct([]))
             return pa.StructArray.from_arrays(children, names=names,
                                               mask=mask)
         child = _col_to_arrow(col.children[0])

@@ -31,9 +31,20 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def make_mesh(n_devices: Optional[int] = None, axis: str = "data") -> Mesh:
+def make_mesh(n_devices: Optional[int] = None, axis: str = "data",
+              cpu_fallback: bool = False) -> Mesh:
+    """Build a 1-D device mesh. `cpu_fallback=True` is for validation runs on
+    underprovisioned machines only (it substitutes virtual CPU devices, whose
+    count is configurable even when the default backend is a TPU); production
+    callers must leave it False so a short slice fails fast instead of
+    silently running on host."""
     devs = jax.devices()
     if n_devices is not None:
+        if len(devs) < n_devices and cpu_fallback:
+            try:
+                devs = jax.devices("cpu")
+            except RuntimeError:
+                pass
         if len(devs) < n_devices:
             raise ValueError(
                 f"requested a {n_devices}-device mesh but only {len(devs)} "

@@ -1,0 +1,115 @@
+"""Pallas row-hash kernels vs the jnp reference implementations.
+
+Runs in Pallas interpret mode on the CPU backend (the kernel itself is
+exercised on real TPU by bench runs); golden behavior is defined by
+ops/hash.py, which is itself golden-tested against Spark vectors in
+test_hash.py.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from spark_rapids_tpu import dtypes, Column
+from spark_rapids_tpu.columnar import Table
+from spark_rapids_tpu.ops import murmur_hash3_32, xxhash64
+from spark_rapids_tpu.ops.hash_pallas import (fused_row_hash,
+                                              murmur_hash3_32_pallas,
+                                              supports, xxhash64_pallas)
+
+BLOCK = 1024  # small block so tiny tables still tile
+
+
+def _i64_col(rng, n, with_nulls=False):
+    k = rng.integers(-2**62, 2**62, size=n, dtype=np.int64)
+    k[: min(5, n)] = [0, -1, 1, np.iinfo(np.int64).min, np.iinfo(np.int64).max][: min(5, n)]
+    validity = jnp.asarray(rng.random(n) > 0.3) if with_nulls else None
+    return Column(dtype=dtypes.INT64, length=n, data=jnp.asarray(k),
+                  validity=validity)
+
+
+@pytest.mark.parametrize("with_nulls", [False, True])
+def test_int64_int32_mixed(with_nulls):
+    rng = np.random.default_rng(7)
+    n = 1000
+    c1 = _i64_col(rng, n, with_nulls)
+    c2 = Column(dtype=dtypes.INT32, length=n,
+                data=jnp.asarray(rng.integers(-2**31, 2**31, size=n,
+                                              dtype=np.int32)))
+    t = Table([c1, c2])
+    assert supports(t)
+    np.testing.assert_array_equal(
+        np.asarray(murmur_hash3_32_pallas(t, seed=42, block_rows=BLOCK).data),
+        np.asarray(murmur_hash3_32(t, seed=42).data))
+    np.testing.assert_array_equal(
+        np.asarray(xxhash64_pallas(t, block_rows=BLOCK).data),
+        np.asarray(xxhash64(t).data))
+    mm, xx = fused_row_hash(t, mm_seed=42, block_rows=BLOCK)
+    np.testing.assert_array_equal(np.asarray(mm.data),
+                                  np.asarray(murmur_hash3_32(t, seed=42).data))
+    np.testing.assert_array_equal(np.asarray(xx.data),
+                                  np.asarray(xxhash64(t).data))
+
+
+def test_narrow_and_decimal_types():
+    rng = np.random.default_rng(3)
+    n = 700
+    cols = [
+        Column(dtype=dtypes.INT8, length=n,
+               data=jnp.asarray(rng.integers(-128, 128, n, dtype=np.int8))),
+        Column(dtype=dtypes.INT16, length=n,
+               data=jnp.asarray(rng.integers(-2**15, 2**15, n, dtype=np.int16))),
+        Column(dtype=dtypes.BOOL, length=n,
+               data=jnp.asarray(rng.random(n) > 0.5)),
+        Column(dtype=dtypes.decimal(12, 2), length=n,
+               data=jnp.asarray(rng.integers(-10**11, 10**11, n,
+                                             dtype=np.int64))),
+    ]
+    t = Table(cols)
+    np.testing.assert_array_equal(
+        np.asarray(murmur_hash3_32_pallas(t, block_rows=BLOCK).data),
+        np.asarray(murmur_hash3_32(t).data))
+    np.testing.assert_array_equal(
+        np.asarray(xxhash64_pallas(t, block_rows=BLOCK).data),
+        np.asarray(xxhash64(t).data))
+
+
+def test_floats_zero_normalization_split():
+    """murmur keeps -0.0 != +0.0, xxhash normalizes (hash.cuh:33-52) — the
+    fused kernel must refuse floats; single-hash paths must match."""
+    rng = np.random.default_rng(11)
+    n = 512
+    f32 = rng.random(n).astype(np.float32)
+    f64 = rng.random(n)
+    f32[:4] = [0.0, -0.0, np.nan, np.inf]
+    f64[:4] = [0.0, -0.0, np.nan, -np.inf]
+    t = Table([Column(dtype=dtypes.FLOAT32, length=n, data=jnp.asarray(f32)),
+               Column(dtype=dtypes.FLOAT64, length=n, data=jnp.asarray(f64))])
+    np.testing.assert_array_equal(
+        np.asarray(murmur_hash3_32_pallas(t, block_rows=BLOCK).data),
+        np.asarray(murmur_hash3_32(t).data))
+    np.testing.assert_array_equal(
+        np.asarray(xxhash64_pallas(t, block_rows=BLOCK).data),
+        np.asarray(xxhash64(t).data))
+    with pytest.raises(TypeError):
+        fused_row_hash(t)
+
+
+def test_non_block_multiple_lengths():
+    rng = np.random.default_rng(5)
+    for n in (1, 127, 128, 1025):
+        t = Table([_i64_col(rng, n, with_nulls=True)])
+        np.testing.assert_array_equal(
+            np.asarray(murmur_hash3_32_pallas(t, block_rows=BLOCK).data),
+            np.asarray(murmur_hash3_32(t).data))
+        np.testing.assert_array_equal(
+            np.asarray(xxhash64_pallas(t, block_rows=BLOCK).data),
+            np.asarray(xxhash64(t).data))
+
+
+def test_strings_not_supported():
+    from spark_rapids_tpu.columnar.column import make_string_column
+    c = make_string_column(jnp.zeros((0,), jnp.uint8),
+                           jnp.zeros((3,), jnp.int32), None)
+    assert not supports(c)
+    with pytest.raises(TypeError):
+        murmur_hash3_32_pallas(c, block_rows=BLOCK)

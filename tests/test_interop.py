@@ -156,6 +156,16 @@ def test_zero_field_struct_imports():
     assert t["s"].length == 2
 
 
+def test_zero_field_struct_round_trip():
+    # export side: StructArray.from_arrays([]) would infer length 0 and
+    # silently drop every row
+    at = pa.table({"s": pa.array([{}, None, {}], type=pa.struct([]))})
+    t = from_arrow(at)
+    back = to_arrow(t)
+    assert back.num_rows == 3
+    assert back.column("s").to_pylist() == [{}, None, {}]
+
+
 def test_from_json_output_exports_to_arrow():
     from spark_rapids_tpu.ops import from_json
     col = Column.from_pylist(['{"x": 1, "y": "two"}', None, "{}"],
