@@ -24,7 +24,8 @@ def main(argv=None):
                 data=jnp.asarray(rng.permutation(nr).astype(np.int64)))
     run_config("inner_join", {"left_rows": nl, "right_rows": nr},
                lambda l, r: [c.data for c in inner_join([l], [r])],
-               (lk, rk), n_rows=nl, iters=args.iters)
+               (lk, rk), n_rows=nl, iters=args.iters,
+               jit=False)  # match count is data-dependent; kernels jitted in-op
 
 
 if __name__ == "__main__":

@@ -27,14 +27,18 @@ def main(argv=None):
 
     n_rows = max(int(1_048_576 * args.scale), 2048)
     col = _random_strings(n_rows, seed=5)
+    pad = col.padded_chars()[0].shape[1]   # static bounds -> one jitted program
     run_config("parse_uri_random", {"num_rows": n_rows},
-               lambda c: parse_uri_to_protocol(c).data,
+               lambda c: parse_uri_to_protocol(c, pad_to=pad,
+                                               out_pad_to=pad).data,
                (col,), n_rows=n_rows, iters=args.iters)
 
     for hit_rate in (0, 50, 100):
         col = uri_mix(n_rows, hit_rate, seed=6)
+        pad = col.padded_chars()[0].shape[1]
         run_config("parse_uri", {"num_rows": n_rows, "hit_rate": hit_rate},
-                   lambda c: parse_uri_to_protocol(c).data,
+                   lambda c: parse_uri_to_protocol(c, pad_to=pad,
+                                                   out_pad_to=pad).data,
                    (col,), n_rows=n_rows, iters=args.iters)
 
 

@@ -33,8 +33,15 @@ def parse_args(argv=None) -> argparse.Namespace:
 
 
 def run_config(bench: str, axes: Dict, fn: Callable, args, *, n_rows: int,
-               iters: int = 10) -> Dict:
-    """Time fn(*args) steady-state; returns + prints the result record."""
+               iters: int = 10, jit: bool = True) -> Dict:
+    """Time fn(*args) steady-state; returns + prints the result record.
+
+    `jit=True` measures the op as deployed — one compiled XLA program
+    (nvbench likewise times the kernel, not per-op dispatch). Ops whose
+    output shapes are data-dependent must either take static bounds from the
+    bench or pass jit=False."""
+    if jit:
+        fn = jax.jit(fn)
     out = fn(*args)
     jax.block_until_ready(out)          # compile + warmup
     t0 = time.perf_counter()

@@ -264,7 +264,13 @@ def strings_from_padded(padded: jnp.ndarray, lengths: jnp.ndarray,
     n, L = padded.shape
     lengths = lengths.astype(jnp.int32)
     offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(lengths)])
-    total = int(offsets[-1])  # host sync; callers inside jit use the dynamic variant
+    if isinstance(offsets, jax.core.Tracer):
+        # under jit the exact char total is not concrete: size the data
+        # buffer by its static upper bound n*L (Arrow permits a data buffer
+        # longer than offsets[-1]; every consumer indexes through offsets)
+        total = n * L
+    else:
+        total = int(offsets[-1])  # host sync, but the buffer is exact-sized
     in_range = jnp.arange(L, dtype=jnp.int32)[None, :] < lengths[:, None]
     dest = offsets[:-1, None] + jnp.arange(L, dtype=jnp.int32)[None, :]
     dest = jnp.where(in_range, dest, total)  # out-of-range writes dropped

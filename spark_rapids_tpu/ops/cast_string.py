@@ -187,11 +187,13 @@ def _pow10(k):
     """10.0**k for integer array k via correctly-rounded table lookup."""
     global _P10_TABLE
     if _P10_TABLE is None:
-        _P10_TABLE = jnp.asarray(
+        # cached as a HOST array: caching a jnp array created during a jit
+        # trace would leak the tracer into later traces
+        _P10_TABLE = np.asarray(
             [float(f"1e{i}") if -324 < i <= 308 else (0.0 if i <= -324 else np.inf)
-             for i in range(_P10_MIN, _P10_MAX + 1)], dtype=jnp.float64)
+             for i in range(_P10_MIN, _P10_MAX + 1)], dtype=np.float64)
     idx = jnp.clip(k - _P10_MIN, 0, _P10_MAX - _P10_MIN)
-    return jnp.take(_P10_TABLE, idx)
+    return jnp.take(jnp.asarray(_P10_TABLE), idx)
 
 
 def _ci_match(C, start, lens, word: bytes):

@@ -165,8 +165,10 @@ _POW10_LIMBS = None
 def pow10_table() -> jnp.ndarray:
     global _POW10_LIMBS
     if _POW10_LIMBS is None:
-        _POW10_LIMBS = from_int([10**k for k in range(77)])
-    return _POW10_LIMBS
+        # cached as a HOST array: caching a traced jnp value would leak the
+        # tracer into later jit traces
+        _POW10_LIMBS = np.asarray(from_int([10**k for k in range(77)]))
+    return jnp.asarray(_POW10_LIMBS)
 
 
 def pow_ten(k) -> jnp.ndarray:

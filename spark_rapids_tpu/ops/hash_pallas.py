@@ -305,6 +305,10 @@ def _run_custom(cols, mm_seed, xx_seed, normalize_zero, block_rows, interpret):
         raise ValueError(f"block_rows must be a multiple of {_LANES}, "
                          f"got {block_rows}")
     n = cols[0].length
+    if any(c.length != n for c in cols):
+        # plain-list inputs bypass Table validation; a short column would
+        # otherwise silently hash its zero padding
+        raise ValueError("all hashed columns must have equal length")
     arrays, layout, n_pad = _pack_inputs(cols, normalize_zero, n, block_rows)
     M = n_pad // _LANES
     TM = block_rows // _LANES

@@ -442,12 +442,25 @@ def _parse_kernel(chars, lens, *, L):
 # ---------------------------------------------------------------------------
 
 
-def _extract(chars_padded, present, start, end, validity):
+def _extract(chars_padded, present, start, end, validity, out_pad_to=None):
     """Build a string column from per-row [start, end) spans of the padded
-    input (gather half of the measure->gather pattern)."""
+    input (gather half of the measure->gather pattern). `out_pad_to` is the
+    static output-width bound that lets the whole parse trace under jax.jit;
+    left None it is measured from the data (host sync)."""
     out_len = jnp.where(present, end - start, 0).astype(jnp.int32)
-    max_len = int(jnp.max(out_len)) if out_len.shape[0] else 0
-    Lout = _round_bucket(max(1, max_len))
+    if out_pad_to is None:
+        max_len = int(jnp.max(out_len)) if out_len.shape[0] else 0
+        Lout = _round_bucket(max(1, max_len))
+    else:
+        Lout = out_pad_to
+        if out_len.shape[0] and not isinstance(out_len, jax.core.Tracer):
+            # a too-small bound silently truncates the gathered chars while
+            # offsets still claim the full span (same guard as padded_chars)
+            m = int(jnp.max(out_len))
+            if m > Lout:
+                raise ValueError(
+                    f"out_pad_to={Lout} is smaller than the longest extracted "
+                    f"span ({m})")
     idx = start[:, None] + jnp.arange(Lout, dtype=jnp.int32)[None, :]
     take = jnp.take_along_axis(chars_padded,
                                jnp.clip(idx, 0, chars_padded.shape[1] - 1),
@@ -461,33 +474,37 @@ def _extract(chars_padded, present, start, end, validity):
                                out_valid)
 
 
-def _parse(column: Column):
+def _parse(column: Column, pad_to=None):
     if not column.dtype.is_string:
         raise TypeError("parse_uri expects a string column")
-    padded, lens = column.padded_chars()
+    padded, lens = column.padded_chars(pad_to)
     parts = _parse_kernel(padded, lens, L=padded.shape[1])
     return padded, lens, parts
 
 
-def parse_uri_to_protocol(column: Column) -> Column:
-    """getScheme() per row; null for invalid URIs (parse_uri.cu:877)."""
-    padded, _, p = _parse(column)
+def parse_uri_to_protocol(column: Column, pad_to=None,
+                          out_pad_to=None) -> Column:
+    """getScheme() per row; null for invalid URIs (parse_uri.cu:877).
+
+    `pad_to`/`out_pad_to` are optional static input/output width bounds that
+    make the call traceable under an enclosing jax.jit."""
+    padded, _, p = _parse(column, pad_to)
     return _extract(padded, p["scheme_present"], p["scheme_start"],
-                    p["scheme_end"], column.validity)
+                    p["scheme_end"], column.validity, out_pad_to)
 
 
-def parse_uri_to_host(column: Column) -> Column:
+def parse_uri_to_host(column: Column, pad_to=None, out_pad_to=None) -> Column:
     """getHost() per row: server-based authorities only (parse_uri.cu:905)."""
-    padded, _, p = _parse(column)
+    padded, _, p = _parse(column, pad_to)
     return _extract(padded, p["host_present"], p["host_start"],
-                    p["host_end"], column.validity)
+                    p["host_end"], column.validity, out_pad_to)
 
 
-def parse_uri_to_query(column: Column) -> Column:
+def parse_uri_to_query(column: Column, pad_to=None, out_pad_to=None) -> Column:
     """getRawQuery() per row (parse_uri.cu:933)."""
-    padded, _, p = _parse(column)
+    padded, _, p = _parse(column, pad_to)
     return _extract(padded, p["query_present"], p["query_start"],
-                    p["query_end"], column.validity)
+                    p["query_end"], column.validity, out_pad_to)
 
 
 @partial(jax.jit, static_argnames=("L", "Lp", "require_nonempty_key"))
@@ -535,18 +552,20 @@ def _find_param_kernel(chars, param, plens, qstart, qend, qpresent, *,
 
 
 def _query_param(column: Column, param_padded, param_lens,
-                 require_nonempty_key: bool) -> Column:
-    padded, _, p = _parse(column)
+                 require_nonempty_key: bool, pad_to=None,
+                 out_pad_to=None) -> Column:
+    padded, _, p = _parse(column, pad_to)
     L = padded.shape[1]
     Lp = param_padded.shape[1]
     found, vstart, vend = _find_param_kernel(
         padded, param_padded, param_lens, p["query_start"], p["query_end"],
         p["query_present"], L=L, Lp=Lp,
         require_nonempty_key=require_nonempty_key)
-    return _extract(padded, found, vstart, vend, column.validity)
+    return _extract(padded, found, vstart, vend, column.validity, out_pad_to)
 
 
-def parse_uri_to_query_literal(column: Column, param: str) -> Column:
+def parse_uri_to_query_literal(column: Column, param: str, pad_to=None,
+                               out_pad_to=None) -> Column:
     """Value of `param` in each row's query (ParseURI.java:70). A match
     needs a non-empty key equal to `param`."""
     n = column.length
@@ -555,15 +574,17 @@ def parse_uri_to_query_literal(column: Column, param: str) -> Column:
     pad = np.zeros((n, Lp), np.uint8)
     pad[:, :len(pb)] = pb[None, :]
     plens = jnp.full((n,), len(pb), jnp.int32)
-    return _query_param(column, jnp.asarray(pad), plens, True)
+    return _query_param(column, jnp.asarray(pad), plens, True, pad_to,
+                        out_pad_to)
 
 
-def parse_uri_to_query_column(column: Column, params: Column) -> Column:
+def parse_uri_to_query_column(column: Column, params: Column, pad_to=None,
+                              out_pad_to=None, param_pad_to=None) -> Column:
     """Per-row parameter column variant (ParseURI.java: parseURIQueryWithColumn)."""
     if not params.dtype.is_string:
         raise TypeError("params must be a string column")
-    ppad, plens = params.padded_chars()
-    out = _query_param(column, ppad, plens, False)
+    ppad, plens = params.padded_chars(param_pad_to)
+    out = _query_param(column, ppad, plens, False, pad_to, out_pad_to)
     if params.validity is not None:
         merged = out.null_mask & params.validity
         return out.with_validity(merged)

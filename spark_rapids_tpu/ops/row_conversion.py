@@ -190,11 +190,14 @@ def convert_from_rows(rows_col: Column, schema: Sequence[dtypes.DType]) -> Table
     if rows_col.dtype.kind != dtypes.Kind.LIST:
         raise TypeError("expected a LIST<UINT8> rows column")
     n = rows_col.length
-    offs = np.asarray(rows_col.offsets)
-    if n and not (offs[0] == 0 and (np.diff(offs) == row_size).all()):
-        raise ValueError(
-            f"rows column must be contiguous with a uniform {row_size}-byte "
-            "stride matching the schema's row layout")
+    if n and not isinstance(rows_col.offsets, jax.core.Tracer):
+        # stride sanity check needs concrete offsets; under jit the layout is
+        # fully determined by the (static) schema anyway
+        offs = np.asarray(rows_col.offsets)
+        if not (offs[0] == 0 and (np.diff(offs) == row_size).all()):
+            raise ValueError(
+                f"rows column must be contiguous with a uniform {row_size}-byte "
+                "stride matching the schema's row layout")
     rows = rows_col.children[0].data[: n * row_size].reshape(n, row_size)
     datas, masks = _from_rows_kernel(
         rows, layout=(tuple(col_offsets), validity_offset, row_size),
