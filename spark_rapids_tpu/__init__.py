@@ -29,8 +29,9 @@ from . import dtypes                                    # noqa: E402
 from .columnar import Column, Table                     # noqa: E402
 
 from .version import __version__, version_info
+from . import api                                       # noqa: E402
 
-__all__ = ["dtypes", "Column", "Table", "__version__", "version_info"]
+__all__ = ["dtypes", "Column", "Table", "api", "__version__", "version_info"]
 
 # Fault-injector auto-load (reference: libcufaultinj.so via
 # CUDA_INJECTION64_PATH at cuInit — faultinj/README.md:20-24).

@@ -18,7 +18,8 @@ from .timezones import (TimeZoneDB, from_timestamp_to_utc_timestamp,
                         is_supported_time_zone)
 from .cast_float_to_string import float_to_string
 from .format_float import format_float
-from .row_conversion import (convert_to_rows,
+from .row_conversion import (convert_from_rows_fixed_width_optimized,
+                             convert_to_rows,
                              convert_to_rows_fixed_width_optimized,
                              convert_from_rows, row_layout)
 from .parse_uri import (parse_uri_to_protocol, parse_uri_to_host,
@@ -49,7 +50,8 @@ __all__ = [
     "from_utc_timestamp_to_timestamp", "is_supported_time_zone",
     "float_to_string", "format_float",
     "convert_to_rows", "convert_to_rows_fixed_width_optimized",
-    "convert_from_rows", "row_layout",
+    "convert_from_rows", "convert_from_rows_fixed_width_optimized",
+    "row_layout",
     "parse_uri_to_protocol", "parse_uri_to_host", "parse_uri_to_query",
     "parse_uri_to_query_literal", "parse_uri_to_query_column",
     "create_histogram_if_valid", "percentile_from_histogram",

@@ -135,16 +135,30 @@ def convert_to_rows(table: Table) -> List[Column]:
                                              data=rows.reshape(-1)))]
 
 
-def convert_to_rows_fixed_width_optimized(table: Table) -> List[Column]:
-    """Same result as convert_to_rows; enforces the optimized path's limits
-    (<100 columns, row <= 1KB — RowConversion.java:32-34,:116)."""
-    if table.num_columns >= _OPTIMIZED_MAX_COLUMNS:
+def _check_optimized_limits(dts: Sequence[dtypes.DType]) -> None:
+    """Optimized-path limits: <100 columns, row <= 1KB
+    (RowConversion.java:32-34,:116)."""
+    if len(dts) >= _OPTIMIZED_MAX_COLUMNS:
         raise ValueError(
             f"fixed-width-optimized conversion handles < {_OPTIMIZED_MAX_COLUMNS} columns")
-    _, _, row_size = row_layout([c.dtype for c in table.columns])
+    _, _, row_size = row_layout(dts)
     if row_size > _OPTIMIZED_MAX_ROW_BYTES:
         raise ValueError(f"row size {row_size} exceeds {_OPTIMIZED_MAX_ROW_BYTES} bytes")
+
+
+def convert_to_rows_fixed_width_optimized(table: Table) -> List[Column]:
+    """Same result as convert_to_rows; enforces the optimized path's limits."""
+    _check_optimized_limits([c.dtype for c in table.columns])
     return convert_to_rows(table)
+
+
+def convert_from_rows_fixed_width_optimized(
+        rows_col: Column, schema: Sequence[dtypes.DType]) -> Table:
+    """Same result as convert_from_rows with the optimized path's limits
+    (the reference routes narrow schemas to a distinct kernel,
+    RowConversionJni.cpp:113; one kernel serves both here)."""
+    _check_optimized_limits(list(schema))
+    return convert_from_rows(rows_col, schema)
 
 
 @partial(jax.jit, static_argnames=("layout", "kinds"))

@@ -1,0 +1,150 @@
+"""Reference-shaped facade (api.py): every Java facade class/method from the
+reference maps onto our ops and round-trips a minimal call.
+
+These are wiring tests — op semantics are covered by the per-op test files.
+"""
+import pytest
+
+from spark_rapids_tpu import Column, Table, api, dtypes
+
+
+def _strings(vals):
+    return Column.from_pylist(vals, dtypes.STRING)
+
+
+def test_cast_strings():
+    c = _strings(["42", " -7 ", "bad"])
+    out = api.CastStrings.toInteger(c, False, dtypes.INT32)
+    assert out.to_pylist() == [42, -7, None]
+    f = api.CastStrings.toFloat(_strings(["1.5", "inf"]), False, dtypes.FLOAT64)
+    assert f.to_pylist() == [1.5, float("inf")]
+    d = api.CastStrings.toDecimal(_strings(["12.34"]), False, 6, 2)
+    assert d.to_pylist() == ["12.34"] or d.to_pylist()[0] is not None
+    s = api.CastStrings.fromFloat(
+        Column.from_pylist([1.0], dtypes.FLOAT32))
+    assert s.to_pylist() == ["1.0"]
+    hexed = api.CastStrings.fromIntegersWithBase(
+        Column.from_pylist([255], dtypes.INT32), 16)
+    assert hexed.to_pylist() == ["FF"]   # Spark conv is uppercase
+    back = api.CastStrings.toIntegersWithBase(_strings(["ff"]), 16, False,
+                                              dtypes.INT32)
+    assert back.to_pylist() == [255]
+
+
+def test_decimal_utils():
+    a = api.CastStrings.toDecimal(_strings(["2.50"]), False, 38, 2)
+    b = api.CastStrings.toDecimal(_strings(["4.00"]), False, 38, 2)
+    overflow, result = api.DecimalUtils.multiply128(a, b, 4)
+    assert overflow.to_pylist() == [False]
+    assert result.to_pylist() == [100000]    # unscaled, scale 4
+    overflow, q = api.DecimalUtils.integerDivide128(a, b)
+    assert q.to_pylist() == [0]
+
+
+def test_hash():
+    c = Column.from_pylist([1, 2], dtypes.INT64)
+    h32 = api.Hash.murmurHash32([c], seed=42)
+    h64 = api.Hash.xxhash64([c])
+    assert h32.dtype.kind == dtypes.Kind.INT32
+    assert h64.dtype.kind == dtypes.Kind.INT64
+
+
+def test_bloom_filter_including_serialized_probe():
+    c = Column.from_pylist([10, 20, 30], dtypes.INT64)
+    bf = api.BloomFilter.create(3, 8 << 10)
+    bf = api.BloomFilter.put(bf, c)
+    hits = api.BloomFilter.probe(bf, c)
+    assert hits.to_pylist() == [True, True, True]
+    from spark_rapids_tpu.ops import bloom_filter_serialize
+    buf = bloom_filter_serialize(bf)
+    hits2 = api.BloomFilter.probe(buf, c)             # serialized overload
+    assert hits2.to_pylist() == [True, True, True]
+    merged = api.BloomFilter.merge([bf, bf])
+    assert api.BloomFilter.probe(merged, c).to_pylist() == [True, True, True]
+
+
+def test_timezone_db():
+    api.GpuTimeZoneDB.cacheDatabase()
+    assert api.GpuTimeZoneDB.isSupportedTimeZone("Asia/Shanghai")
+    ts = Column.from_pylist([0], dtypes.TIMESTAMP_US)
+    utc = api.GpuTimeZoneDB.fromTimestampToUtcTimestamp(ts, "Asia/Shanghai")
+    assert utc.to_pylist() == [-8 * 3600 * 1_000_000]
+    back = api.GpuTimeZoneDB.fromUtcTimestampToTimestamp(utc, "Asia/Shanghai")
+    assert back.to_pylist() == [0]
+    api.GpuTimeZoneDB.shutdown()
+
+
+def test_datetime_rebase():
+    d = Column.from_pylist([0], dtypes.DATE32)
+    j = api.DateTimeRebase.rebaseGregorianToJulian(d)
+    g = api.DateTimeRebase.rebaseJulianToGregorian(j)
+    assert g.to_pylist() == [0]
+
+
+def test_map_utils():
+    m = api.MapUtils.extractRawMapFromJsonString(_strings(['{"a": "1"}']))
+    assert m.to_pylist() == [[{"key": "a", "value": "1"}]]
+
+
+def test_parse_uri():
+    c = _strings(["https://example.com/x?a=1"])
+    assert api.ParseURI.parseURIProtocol(c).to_pylist() == ["https"]
+    assert api.ParseURI.parseURIHost(c).to_pylist() == ["example.com"]
+    assert api.ParseURI.parseURIQuery(c).to_pylist() == ["a=1"]
+    assert api.ParseURI.parseURIQueryWithLiteral(c, "a").to_pylist() == ["1"]
+    assert api.ParseURI.parseURIQueryWithColumn(
+        c, _strings(["a"])).to_pylist() == ["1"]
+
+
+def test_histogram():
+    v = Column.from_pylist([1.0, 2.0], dtypes.FLOAT64)
+    f = Column.from_pylist([3, 4], dtypes.INT64)
+    h = api.Histogram.createHistogramIfValid(v, f, True)
+    pct = api.Histogram.percentileFromHistogram(h, [0.5], False)
+    assert pct.length == 2
+
+
+def test_zorder_including_zero_column_corners():
+    c = Column.from_pylist([1, 2], dtypes.INT32)
+    ib = api.ZOrder.interleaveBits(2, c, c)
+    assert ib.length == 2
+    hi = api.ZOrder.hilbertIndex(4, 2, c, c)
+    assert hi.length == 2
+    empty_ib = api.ZOrder.interleaveBits(3)
+    assert empty_ib.length == 3
+    assert empty_ib.to_pylist() == [[], [], []]
+    empty_hi = api.ZOrder.hilbertIndex(4, 3)
+    assert empty_hi.to_pylist() == [0, 0, 0]
+
+
+def test_row_conversion_both_variants():
+    t = Table([Column.from_pylist([1, None, 3], dtypes.INT32),
+               Column.from_pylist([4, 5, 6], dtypes.INT64)])
+    [rows] = api.RowConversion.convertToRows(t)
+    back = api.RowConversion.convertFromRows(rows, dtypes.INT32, dtypes.INT64)
+    assert back[0].to_pylist() == [1, None, 3]
+    assert back[1].to_pylist() == [4, 5, 6]
+    [rows2] = api.RowConversion.convertToRowsFixedWidthOptimized(t)
+    back2 = api.RowConversion.convertFromRowsFixedWidthOptimized(
+        rows2, dtypes.INT32, dtypes.INT64)
+    assert back2[0].to_pylist() == [1, None, 3]
+    with pytest.raises(ValueError):
+        api.RowConversion.convertToRowsFixedWidthOptimized(
+            Table([Column.from_pylist([1], dtypes.INT32)] * 120))
+
+
+def test_rmm_spark_lifecycle_and_metrics():
+    api.RmmSpark.clearEventHandler()          # idempotent from any state
+    api.RmmSpark.setEventHandler()
+    try:
+        api.RmmSpark.currentThreadIsDedicatedToTask(7)
+        from spark_rapids_tpu.runtime.adaptor import current_thread_id
+        assert api.RmmSpark.getStateOf(current_thread_id()) == "THREAD_RUNNING"
+        api.RmmSpark.taskDone(7)
+        assert api.RmmSpark.getAndResetNumRetryThrow(7) == 0
+    finally:
+        api.RmmSpark.clearEventHandler()
+
+
+def test_parquet_footer_reexport():
+    assert api.ParquetFooter is not None
