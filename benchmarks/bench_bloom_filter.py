@@ -23,10 +23,13 @@ def main(argv=None):
 
     for bf_bytes in (512 << 10, 1 << 20, 2 << 20, 4 << 20, 8 << 20):
         bf = bloom_filter_create(num_hashes, bf_bytes // 8)
-        run_config("bloom_filter_put",
-                   {"bloom_filter_bytes": bf_bytes, "num_rows": num_rows},
-                   lambda c, b=bf: bloom_filter_put(b, c).bits,
-                   (hashed,), n_rows=num_rows, iters=args.iters)
+        for sort_indices in (False, True):
+            run_config("bloom_filter_put",
+                       {"bloom_filter_bytes": bf_bytes, "num_rows": num_rows,
+                        "sort_indices": sort_indices},
+                       lambda c, b=bf, s=sort_indices:
+                           bloom_filter_put(b, c, sort_indices=s).bits,
+                       (hashed,), n_rows=num_rows, iters=args.iters)
         full = bloom_filter_put(bf, hashed)
         run_config("bloom_filter_probe",
                    {"bloom_filter_bytes": bf_bytes, "num_rows": num_rows},

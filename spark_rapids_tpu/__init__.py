@@ -29,9 +29,19 @@ from . import dtypes                                    # noqa: E402
 from .columnar import Column, Table                     # noqa: E402
 
 from .version import __version__, version_info
-from . import api                                       # noqa: E402
 
 __all__ = ["dtypes", "Column", "Table", "api", "__version__", "version_info"]
+
+
+def __getattr__(name):
+    # `api` imports the whole ops package, whose module-level jnp constants
+    # initialize the JAX backend — lazy (PEP 562) so a bare
+    # `import spark_rapids_tpu` stays side-effect-free and callers can pin
+    # a platform first (a dead device tunnel would otherwise hang here).
+    if name == "api":
+        import importlib
+        return importlib.import_module(".api", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 # Fault-injector auto-load (reference: libcufaultinj.so via
 # CUDA_INJECTION64_PATH at cuInit — faultinj/README.md:20-24).

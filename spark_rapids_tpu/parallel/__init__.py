@@ -1,9 +1,9 @@
 from .shuffle import (partition_ids, build_partition_map, exchange,
                       repartition_table, make_mesh)
-from .relational import (distributed_groupby, distributed_inner_join,
-                         distributed_sort)
+from .relational import (distributed_broadcast_join, distributed_groupby,
+                         distributed_inner_join, distributed_sort)
 
 __all__ = ["partition_ids", "build_partition_map", "exchange",
            "repartition_table", "make_mesh",
            "distributed_groupby", "distributed_inner_join",
-           "distributed_sort"]
+           "distributed_broadcast_join", "distributed_sort"]

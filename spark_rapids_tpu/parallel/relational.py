@@ -308,3 +308,44 @@ def distributed_inner_join(mesh: Mesh, lkeys: jnp.ndarray, lvals: jnp.ndarray,
     fn = shard_map(local, mesh=mesh, in_specs=(spec,) * 4,
                    out_specs=(spec,) * 5)
     return fn(lkeys, lvals, rkeys, rvals)
+
+
+def distributed_broadcast_join(mesh: Mesh, lkeys: jnp.ndarray,
+                               lvals: jnp.ndarray, rkeys: jnp.ndarray,
+                               rvals: jnp.ndarray, row_cap: int,
+                               axis: str = "data"):
+    """Broadcast inner equi-join: `jax.lax.all_gather` replicates the (small)
+    right side onto every shard over ICI — XLA lowers the gather to a ring of
+    ICI hops — and each left shard joins locally. The probe side never moves,
+    so collective traffic is O(|right| x peers) instead of reshuffling both
+    sides: the TPU analogue of the BroadcastHashJoin the reference's plugin
+    accelerates one level up (SURVEY.md §2.4's UCX-shuffle slot; here the
+    broadcast IS the collective).
+
+    `row_cap` bounds the per-shard join output (static shapes); returns
+    per-shard padded (lkey, lval, rval, valid, overflow) exactly like
+    distributed_inner_join, so callers reuse the same SplitAndRetry contract.
+    """
+    from ..ops.join import _expand, _match_spans, _union_ranks
+
+    def local(lk, lv, rk, rv):
+        Rk = jax.lax.all_gather(rk, axis, tiled=True)
+        Rv = jax.lax.all_gather(rv, axis, tiled=True)
+        nl = lk.shape[0]
+        ranks = _union_ranks((jnp.concatenate([lk, Rk]),), n_ops=1)
+        all_l = jnp.ones((nl,), jnp.bool_)
+        all_r = jnp.ones((Rk.shape[0],), jnp.bool_)
+        counts, lo, rorder = _match_spans(ranks[:nl], all_l, ranks[nl:], all_r)
+        lsel, rsel = _expand(counts, lo, rorder, total=row_cap, outer=False)
+        total = jnp.sum(counts)
+        live = jnp.arange(row_cap, dtype=jnp.int32) < total
+        out_lk = jnp.where(live, jnp.take(lk, lsel, axis=0), 0)
+        out_lv = jnp.where(live, jnp.take(lv, lsel, axis=0), 0)
+        out_rv = jnp.where(live, jnp.take(Rv, rsel, axis=0), 0)
+        overflow = (total > row_cap).reshape(1)
+        return out_lk, out_lv, out_rv, live, overflow
+
+    spec = P(axis)
+    fn = shard_map(local, mesh=mesh, in_specs=(spec,) * 4,
+                   out_specs=(spec,) * 5)
+    return fn(lkeys, lvals, rkeys, rvals)

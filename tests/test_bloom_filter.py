@@ -127,3 +127,15 @@ def test_deserialize_validation():
         (1).to_bytes(4, "big") + b"\x00" * 8
     with pytest.raises(ValueError):
         bloom_filter_deserialize(np.frombuffer(bad_version, np.uint8))
+
+
+def test_put_sort_indices_variant_matches():
+    import numpy as np
+    rng = np.random.default_rng(5)
+    vals = Column.from_pylist(
+        [int(v) for v in rng.integers(-2**62, 2**62, 500)] + [None],
+        dtypes.INT64)
+    bf = bloom_filter_create(3, 1024)
+    a = bloom_filter_put(bf, vals)
+    b = bloom_filter_put(bf, vals, sort_indices=True)
+    np.testing.assert_array_equal(np.asarray(a.bits), np.asarray(b.bits))

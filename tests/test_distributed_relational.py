@@ -156,3 +156,39 @@ def test_distributed_inner_join_matches_local():
     ri = np.asarray(rmap.data)
     expect = sorted(zip(lk[li].tolist(), lv[li].tolist(), rv[ri].tolist()))
     assert got == expect
+
+
+def test_broadcast_join_matches_local():
+    from spark_rapids_tpu.parallel import distributed_broadcast_join
+    mesh = _mesh()
+    rng = np.random.default_rng(21)
+    nl, nr = NDEV * 40, NDEV * 6
+    lk = rng.integers(0, 30, nl).astype(np.int64)
+    lv = rng.integers(-100, 100, nl).astype(np.int64)
+    rk = rng.permutation(64)[:nr].astype(np.int64)
+    rv = rng.integers(-100, 100, nr).astype(np.int64)
+    sh = NamedSharding(mesh, P("data"))
+    args = [jax.device_put(jnp.asarray(x), sh) for x in (lk, lv, rk, rv)]
+    out_lk, out_lv, out_rv, valid, overflow = distributed_broadcast_join(
+        mesh, *args, row_cap=nl * 3 // NDEV)
+    assert not bool(jnp.any(overflow))
+    got = sorted(zip(np.asarray(out_lk)[np.asarray(valid)].tolist(),
+                     np.asarray(out_lv)[np.asarray(valid)].tolist(),
+                     np.asarray(out_rv)[np.asarray(valid)].tolist()))
+    want = sorted((int(k), int(v), int(w))
+                  for k, v in zip(lk, lv) for rk_, w in zip(rk, rv) if k == rk_)
+    assert got == want
+
+
+def test_broadcast_join_overflow_flag():
+    from spark_rapids_tpu.parallel import distributed_broadcast_join
+    mesh = _mesh()
+    nl = NDEV * 8
+    lk = np.zeros(nl, np.int64)           # every left row matches
+    lv = np.arange(nl, dtype=np.int64)
+    rk = np.zeros(NDEV, np.int64)
+    rv = np.arange(NDEV, dtype=np.int64)
+    sh = NamedSharding(mesh, P("data"))
+    args = [jax.device_put(jnp.asarray(x), sh) for x in (lk, lv, rk, rv)]
+    *_, overflow = distributed_broadcast_join(mesh, *args, row_cap=4)
+    assert bool(jnp.any(overflow))        # 8*NDEV matches per shard >> 4

@@ -28,6 +28,13 @@ from typing import List, Optional
 
 sys.path.insert(0, ".")
 
+# This is a host-side state-machine fuzzer: it never launches device work, so
+# pin the CPU backend before anything can initialize an accelerator (a dead
+# device tunnel would otherwise hang the whole harness at backend init).
+import os  # noqa: E402
+import jax  # noqa: E402
+jax.config.update("jax_platforms", os.environ.get("SRT_MC_PLATFORM", "cpu"))
+
 from spark_rapids_tpu.runtime import (DeviceSession, HardOOM, MemoryBudget,  # noqa: E402
                                       Reservation, ResourceArbiter, with_retry)
 
