@@ -140,7 +140,13 @@ class BloomFilter:
 
     @staticmethod
     def merge(bloomFilters: Sequence):
-        return ops.bloom_filter_merge(list(bloomFilters))
+        """Accepts device filters or serialized wire buffers — the reference's
+        merge input is a column of executor-serialized filters
+        (BloomFilter.java:66-74)."""
+        filters = [f if isinstance(f, ops.BloomFilter)
+                   else ops.bloom_filter_deserialize(f)
+                   for f in bloomFilters]
+        return ops.bloom_filter_merge(filters)
 
     @staticmethod
     def probe(bloomFilter, cv: Column) -> Column:

@@ -30,6 +30,7 @@ class Kind(enum.Enum):
     INT16 = "int16"
     INT32 = "int32"
     INT64 = "int64"
+    UINT64 = "uint64"          # Spark conv() works in the unsigned-64 domain
     FLOAT32 = "float32"
     FLOAT64 = "float64"
     DECIMAL32 = "decimal32"
@@ -67,6 +68,9 @@ class DType:
 
     @property
     def is_integer(self) -> bool:
+        # UINT64 is deliberately excluded: it exists only for conv()'s
+        # unsigned-64 domain (CastStrings.toIntegersWithBase), not as a
+        # general numeric type — aggregations over it would wrap at 2^63
         return self.kind in (Kind.INT8, Kind.INT16, Kind.INT32, Kind.INT64)
 
     @property
@@ -90,6 +94,7 @@ class DType:
             Kind.INT16: jnp.int16,
             Kind.INT32: jnp.int32,
             Kind.INT64: jnp.int64,
+            Kind.UINT64: jnp.uint64,
             Kind.FLOAT32: jnp.float32,
             Kind.FLOAT64: jnp.float64,
             Kind.DECIMAL32: jnp.int32,
@@ -106,7 +111,7 @@ class DType:
         """Bytes per row of the primary buffer (Spark row-format width)."""
         return {
             Kind.BOOL: 1, Kind.INT8: 1, Kind.UINT8: 1, Kind.INT16: 2, Kind.INT32: 4,
-            Kind.INT64: 8, Kind.FLOAT32: 4, Kind.FLOAT64: 8,
+            Kind.INT64: 8, Kind.UINT64: 8, Kind.FLOAT32: 4, Kind.FLOAT64: 8,
             Kind.DECIMAL32: 4, Kind.DECIMAL64: 8, Kind.DECIMAL128: 16,
             Kind.DATE32: 4, Kind.TIMESTAMP_US: 8,
             Kind.TIMESTAMP_S: 8, Kind.TIMESTAMP_MS: 8,
@@ -130,6 +135,7 @@ UINT8 = DType(Kind.UINT8)
 INT16 = DType(Kind.INT16)
 INT32 = DType(Kind.INT32)
 INT64 = DType(Kind.INT64)
+UINT64 = DType(Kind.UINT64)
 FLOAT32 = DType(Kind.FLOAT32)
 FLOAT64 = DType(Kind.FLOAT64)
 STRING = DType(Kind.STRING)

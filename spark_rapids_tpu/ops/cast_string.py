@@ -461,11 +461,17 @@ def integer_to_string_with_base(col: Column, base: int = 10) -> Column:
         out = jnp.take_along_axis(chars, jnp.clip(idx, 0, ndig - 1), axis=1)
         return strings_from_padded(out.astype(jnp.uint8), lens_out, col.validity)
     # base 10
-    v = col.data.astype(jnp.int64)
-    neg = v < 0
-    mag = jnp.where(neg, -v.astype(jnp.uint64), v.astype(jnp.uint64))
-    # careful: -INT64_MIN wraps to itself, which is the correct magnitude bits
-    mag = jnp.where(v == jnp.int64(-(2**63)), jnp.uint64(2**63), mag)
+    if col.dtype.kind == Kind.UINT64:
+        # Spark conv() prints the unsigned value ("-510" parsed base 10 comes
+        # back as 18446744073709551106, CastStringsTest.baseDec2HexTestMixed)
+        mag = col.data.astype(jnp.uint64)
+        neg = jnp.zeros((n,), jnp.bool_)
+    else:
+        v = col.data.astype(jnp.int64)
+        neg = v < 0
+        mag = jnp.where(neg, -v.astype(jnp.uint64), v.astype(jnp.uint64))
+        # careful: -INT64_MIN wraps to itself, the correct magnitude bits
+        mag = jnp.where(v == jnp.int64(-(2**63)), jnp.uint64(2**63), mag)
     ndig = 20
     pows = jnp.asarray([10**k for k in range(ndig)], dtype=jnp.uint64)
     digs = ((mag[:, None] // pows[None, ::-1]) % jnp.uint64(10)).astype(jnp.int32)

@@ -262,6 +262,23 @@ def distributed_sort(mesh: Mesh, keys: jnp.ndarray, vals: jnp.ndarray,
     return fn(keys, vals)
 
 
+def _local_join_tail(lk, lv, lalive, rk, rv, ralive, row_cap: int):
+    """Shard-local inner join into a fixed row_cap: union rank + sort-merge
+    spans + padded expansion (ops/join.py machinery on shard-local shapes).
+    Returns (lkey, lval, rval, live, overflow-scalar)."""
+    from ..ops.join import _expand, _match_spans, _union_ranks
+    nl = lk.shape[0]
+    ranks = _union_ranks((jnp.concatenate([lk, rk]),), n_ops=1)
+    counts, lo, rorder = _match_spans(ranks[:nl], lalive, ranks[nl:], ralive)
+    lsel, rsel = _expand(counts, lo, rorder, total=row_cap, outer=False)
+    total = jnp.sum(counts)
+    live = jnp.arange(row_cap, dtype=jnp.int32) < total
+    out_lk = jnp.where(live, jnp.take(lk, lsel, axis=0), 0)
+    out_lv = jnp.where(live, jnp.take(lv, lsel, axis=0), 0)
+    out_rv = jnp.where(live, jnp.take(rv, rsel, axis=0), 0)
+    return out_lk, out_lv, out_rv, live, total > row_cap
+
+
 def distributed_inner_join(mesh: Mesh, lkeys: jnp.ndarray, lvals: jnp.ndarray,
                            rkeys: jnp.ndarray, rvals: jnp.ndarray,
                            row_cap: int, slack: float = 2.0,
@@ -288,20 +305,9 @@ def distributed_inner_join(mesh: Mesh, lkeys: jnp.ndarray, lvals: jnp.ndarray,
         Lk, Lv, Lalive, lspill = reshuffle(lk, lv)
         Rk, Rv, Ralive, rspill = reshuffle(rk, rv)
 
-        # shard-local join via union rank + sort-merge spans + padded
-        # expansion (ops/join.py machinery, shard-local shapes)
-        from ..ops.join import _expand, _match_spans, _union_ranks
-        nl = Lk.shape[0]
-        ranks = _union_ranks((jnp.concatenate([Lk, Rk]),), n_ops=1)
-        counts, lo, rorder = _match_spans(ranks[:nl], Lalive,
-                                          ranks[nl:], Ralive)
-        lsel, rsel = _expand(counts, lo, rorder, total=row_cap, outer=False)
-        total = jnp.sum(counts)
-        live = jnp.arange(row_cap, dtype=jnp.int32) < total
-        out_lk = jnp.where(live, jnp.take(Lk, lsel, axis=0), 0)
-        out_lv = jnp.where(live, jnp.take(Lv, lsel, axis=0), 0)
-        out_rv = jnp.where(live, jnp.take(Rv, rsel, axis=0), 0)
-        overflow = (total > row_cap) | lspill | rspill
+        out_lk, out_lv, out_rv, live, joverflow = _local_join_tail(
+            Lk, Lv, Lalive, Rk, Rv, Ralive, row_cap)
+        overflow = joverflow | lspill | rspill
         return out_lk, out_lv, out_rv, live, overflow.reshape(1)
 
     spec = P(axis)
@@ -326,24 +332,14 @@ def distributed_broadcast_join(mesh: Mesh, lkeys: jnp.ndarray,
     per-shard padded (lkey, lval, rval, valid, overflow) exactly like
     distributed_inner_join, so callers reuse the same SplitAndRetry contract.
     """
-    from ..ops.join import _expand, _match_spans, _union_ranks
-
     def local(lk, lv, rk, rv):
         Rk = jax.lax.all_gather(rk, axis, tiled=True)
         Rv = jax.lax.all_gather(rv, axis, tiled=True)
-        nl = lk.shape[0]
-        ranks = _union_ranks((jnp.concatenate([lk, Rk]),), n_ops=1)
-        all_l = jnp.ones((nl,), jnp.bool_)
+        all_l = jnp.ones((lk.shape[0],), jnp.bool_)
         all_r = jnp.ones((Rk.shape[0],), jnp.bool_)
-        counts, lo, rorder = _match_spans(ranks[:nl], all_l, ranks[nl:], all_r)
-        lsel, rsel = _expand(counts, lo, rorder, total=row_cap, outer=False)
-        total = jnp.sum(counts)
-        live = jnp.arange(row_cap, dtype=jnp.int32) < total
-        out_lk = jnp.where(live, jnp.take(lk, lsel, axis=0), 0)
-        out_lv = jnp.where(live, jnp.take(lv, lsel, axis=0), 0)
-        out_rv = jnp.where(live, jnp.take(Rv, rsel, axis=0), 0)
-        overflow = (total > row_cap).reshape(1)
-        return out_lk, out_lv, out_rv, live, overflow
+        out_lk, out_lv, out_rv, live, overflow = _local_join_tail(
+            lk, lv, all_l, Rk, Rv, all_r, row_cap)
+        return out_lk, out_lv, out_rv, live, overflow.reshape(1)
 
     spec = P(axis)
     fn = shard_map(local, mesh=mesh, in_specs=(spec,) * 4,

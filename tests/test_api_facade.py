@@ -61,6 +61,9 @@ def test_bloom_filter_including_serialized_probe():
     assert hits2.to_pylist() == [True, True, True]
     merged = api.BloomFilter.merge([bf, bf])
     assert api.BloomFilter.probe(merged, c).to_pylist() == [True, True, True]
+    # executor-side shape: merge serialized wire buffers (BloomFilter.java:66)
+    merged2 = api.BloomFilter.merge([buf, buf])
+    assert api.BloomFilter.probe(merged2, c).to_pylist() == [True, True, True]
 
 
 def test_timezone_db():

@@ -243,3 +243,32 @@ class TestReviewRegressions:
         r = string_to_integer_with_base(scol(["\f123", "\x0b45", "\f"]),
                                         dtypes.INT64, 10)
         assert r.to_pylist() == [123, 45, None]
+
+
+class TestConvUnsigned64:
+    """Spark conv() unsigned-64 domain — vectors from the reference's
+    CastStringsTest.baseDec2HexTestMixed / baseHex2DecTest."""
+
+    def _conv(self, vals, from_base):
+        c = scol(vals)
+        u = string_to_integer_with_base(c, dtypes.UINT64, from_base)
+        return (integer_to_string_with_base(u, 10).to_pylist(),
+                integer_to_string_with_base(u, 16).to_pylist())
+
+    def test_dec2hex_mixed(self):
+        dec, hexs = self._conv(
+            [None, " ", "junk-510junk510", "--510", "   -510junk510",
+             "  510junk510", "510", "00510", "00-510"], 10)
+        assert dec == [None, None, "0", "0", "18446744073709551106",
+                       "510", "510", "510", "0"]
+        assert hexs == [None, None, "0", "0", "FFFFFFFFFFFFFE02",
+                        "1FE", "1FE", "1FE", "0"]
+
+    def test_hex2dec(self):
+        dec, hexs = self._conv(
+            [None, "junk", "0", "f", "junk-5Ajunk5A", "--5A", "   -5Ajunk5A",
+             "  5Ajunk5A", "5a", "05a", "005a", "00-5a", "NzGGImWNRh"], 16)
+        assert dec == [None, "0", "0", "15", "0", "0", "18446744073709551526",
+                       "90", "90", "90", "90", "0", "0"]
+        assert hexs == [None, "0", "0", "F", "0", "0", "FFFFFFFFFFFFFFA6",
+                        "5A", "5A", "5A", "5A", "0", "0"]
