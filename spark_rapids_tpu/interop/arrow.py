@@ -79,6 +79,7 @@ def _col_to_arrow(col: Column) -> pa.Array:
     pa_type = {
         Kind.BOOL: pa.bool_(), Kind.INT8: pa.int8(), Kind.UINT8: pa.uint8(),
         Kind.INT16: pa.int16(), Kind.INT32: pa.int32(), Kind.INT64: pa.int64(),
+        Kind.UINT64: pa.uint64(),
         Kind.FLOAT32: pa.float32(), Kind.FLOAT64: pa.float64(),
         Kind.DATE32: pa.date32(), Kind.TIMESTAMP_US: pa.timestamp("us"),
         Kind.TIMESTAMP_MS: pa.timestamp("ms"), Kind.TIMESTAMP_S: pa.timestamp("s"),
@@ -185,6 +186,7 @@ def _col_from_arrow(arr: pa.ChunkedArray | pa.Array, name: str) -> Column:
     m = {pa.bool_(): dtypes.BOOL, pa.int8(): dtypes.INT8,
          pa.uint8(): dtypes.UINT8, pa.int16(): dtypes.INT16,
          pa.int32(): dtypes.INT32, pa.int64(): dtypes.INT64,
+         pa.uint64(): dtypes.UINT64,
          pa.float32(): dtypes.FLOAT32, pa.float64(): dtypes.FLOAT64,
          pa.date32(): dtypes.DATE32, pa.timestamp("us"): dtypes.TIMESTAMP_US,
          pa.timestamp("ms"): dtypes.TIMESTAMP_MS,

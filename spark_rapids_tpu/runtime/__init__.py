@@ -11,14 +11,16 @@ from .adaptor import (ResourceArbiter, OomInjectionType, current_thread_id,
                       STATE_ALLOC_FREE, STATE_BLOCKED, STATE_BUFN_THROW,
                       STATE_BUFN_WAIT, STATE_BUFN, STATE_SPLIT_THROW,
                       STATE_REMOVE_THROW, STATE_NAMES)
-from .pool import MemoryBudget, DeviceSession, Reservation
+from .pool import (DeviceSession, MemoryBudget, MemoryEventHandler,
+                   Reservation)
 from .retry import with_retry
 
 __all__ = [
     "ResourceArbiter", "OomInjectionType", "current_thread_id",
     "ArbiterOOM", "RetryOOM", "SplitAndRetryOOM", "CpuRetryOOM",
     "CpuSplitAndRetryOOM", "HardOOM", "InjectedException", "ThreadRemovedError",
-    "MemoryBudget", "DeviceSession", "Reservation", "with_retry",
+    "MemoryBudget", "MemoryEventHandler", "DeviceSession", "Reservation",
+    "with_retry",
     "STATE_UNKNOWN", "STATE_RUNNING", "STATE_ALLOC", "STATE_ALLOC_FREE",
     "STATE_BLOCKED", "STATE_BUFN_THROW", "STATE_BUFN_WAIT", "STATE_BUFN",
     "STATE_SPLIT_THROW", "STATE_REMOVE_THROW", "STATE_NAMES",

@@ -31,6 +31,30 @@ class Reservation:
     _released: bool = False
 
 
+class MemoryEventHandler:
+    """Spill hook, the slot RmmEventHandlerResourceAdaptor fills in the
+    reference's allocator chain (SparkResourceAdaptor → event-handler adaptor
+    → pool; SURVEY.md §3.2 "child mr chain"). The plugin registers one whose
+    on_alloc_failure makes buffers spillable/frees them and returns True to
+    retry the allocation immediately — BEFORE the task-level blocking/retry
+    state machine gets involved.
+
+    Subclass and override; default is a no-op handler."""
+
+    def on_alloc_failure(self, nbytes: int, retry_count: int) -> bool:
+        """Called when a reservation doesn't fit. Return True if memory may
+        have been freed (spilled) and the reservation should be retried
+        immediately; False to fall through to the arbiter's blocking retry."""
+        return False
+
+    def on_allocated(self, total_used: int) -> None:
+        """Called after a successful reservation with the new used total
+        (the reference's alloc-threshold callback, coarse-grained)."""
+
+    def on_deallocated(self, total_used: int) -> None:
+        """Called after a release with the new used total."""
+
+
 class MemoryBudget:
     """A byte budget for one memory space, fronted by the arbiter.
 
@@ -40,10 +64,12 @@ class MemoryBudget:
     back, then notify the arbiter so blocked threads wake.
     """
 
-    def __init__(self, arbiter: ResourceArbiter, limit_bytes: int, is_cpu: bool = False):
+    def __init__(self, arbiter: ResourceArbiter, limit_bytes: int, is_cpu: bool = False,
+                 event_handler: Optional[MemoryEventHandler] = None):
         self.arbiter = arbiter
         self.limit = int(limit_bytes)
         self.is_cpu = is_cpu
+        self.event_handler = event_handler
         self._used = 0
         self._mu = threading.Lock()
 
@@ -85,10 +111,40 @@ class MemoryBudget:
 
     def _attempt(self, nbytes: int, blocking: bool) -> Optional[Reservation]:
         recursive = self.arbiter.pre_alloc(is_cpu=self.is_cpu, blocking=blocking)
-        ok = self._try_reserve(nbytes)
+        ok = False
+        try:
+            ok = self._try_reserve(nbytes)
+            if not ok and self.event_handler is not None:
+                # spill loop: let the handler free memory and retry
+                # immediately, before the task-level state machine blocks this
+                # thread (the RmmEventHandlerResourceAdaptor contract:
+                # onAllocFailure returns true -> retry the allocation)
+                spill_retries = 0
+                while not ok and self.event_handler.on_alloc_failure(
+                        nbytes, spill_retries):
+                    spill_retries += 1
+                    ok = self._try_reserve(nbytes)
+        except BaseException:
+            # a raising handler must not leave this thread parked in the
+            # arbiter's ALLOC state (every later pre_alloc would look
+            # recursive and bypass blocking admission)
+            if ok:
+                with self._mu:
+                    self._used -= nbytes
+            self.arbiter.post_alloc_failed(
+                is_cpu=self.is_cpu, was_oom=False, blocking=False,
+                was_recursive=recursive)
+            raise
         if ok:
             self.arbiter.post_alloc_success(is_cpu=self.is_cpu, was_recursive=recursive)
-            return Reservation(nbytes=nbytes, is_cpu=self.is_cpu)
+            r = Reservation(nbytes=nbytes, is_cpu=self.is_cpu)
+            if self.event_handler is not None:
+                try:
+                    self.event_handler.on_allocated(self.used)
+                except BaseException:
+                    self.release(r)   # undo: the caller never sees r
+                    raise
+            return r
         retry = self.arbiter.post_alloc_failed(
             is_cpu=self.is_cpu, was_oom=True, blocking=blocking, was_recursive=recursive)
         if blocking and not retry:
@@ -103,6 +159,8 @@ class MemoryBudget:
             self._used -= r.nbytes
         if r.nbytes > 0:
             self.arbiter.dealloc(is_cpu=self.is_cpu)
+            if self.event_handler is not None:
+                self.event_handler.on_deallocated(self.used)
 
 
 class DeviceSession:
@@ -112,9 +170,11 @@ class DeviceSession:
     (SURVEY.md §3.3)."""
 
     def __init__(self, device_limit_bytes: int, host_limit_bytes: int = 0,
-                 log_loc: Optional[str] = None, watchdog: bool = True):
+                 log_loc: Optional[str] = None, watchdog: bool = True,
+                 event_handler: Optional[MemoryEventHandler] = None):
         self.arbiter = ResourceArbiter(log_loc=log_loc, watchdog=watchdog)
-        self.device = MemoryBudget(self.arbiter, device_limit_bytes, is_cpu=False)
+        self.device = MemoryBudget(self.arbiter, device_limit_bytes,
+                                   is_cpu=False, event_handler=event_handler)
         self.host = MemoryBudget(self.arbiter, host_limit_bytes, is_cpu=True)
 
     def close(self):

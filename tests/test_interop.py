@@ -191,3 +191,16 @@ def test_from_arrow_date_timestamp():
     assert t["ts"].dtype == dtypes.TIMESTAMP_US
     back = to_arrow(t)
     assert back.column("ts").to_pylist() == at.column("ts").to_pylist()
+
+
+def test_uint64_round_trip():
+    import jax.numpy as jnp
+    # conv()'s unsigned-64 intermediate must cross the Arrow boundary
+    c = Column(dtype=dtypes.UINT64, length=3,
+               data=jnp.asarray(np.array([0, 2**64 - 510, 510], np.uint64)),
+               validity=jnp.asarray([True, True, False]))
+    at = to_arrow(Table([c], names=["u"]))
+    assert at.schema.field("u").type == pa.uint64()
+    back = from_arrow(at)
+    assert back["u"].dtype.kind == dtypes.Kind.UINT64
+    assert back["u"].to_pylist() == [0, 2**64 - 510, None]

@@ -13,7 +13,8 @@ import queue
 import pytest
 
 from spark_rapids_tpu.runtime import (
-    ResourceArbiter, DeviceSession, MemoryBudget, OomInjectionType,
+    ResourceArbiter, DeviceSession, MemoryBudget, MemoryEventHandler,
+    OomInjectionType,
     RetryOOM, SplitAndRetryOOM, CpuRetryOOM, CpuSplitAndRetryOOM,
     HardOOM, InjectedException, with_retry,
     STATE_RUNNING, STATE_BLOCKED, STATE_BUFN, STATE_BUFN_WAIT,
@@ -551,3 +552,63 @@ def test_non_blocking_alloc_failure_does_not_block(session):
         one.run(lambda: session.device.release(r1))
     finally:
         one.done()
+
+
+class SpillStore(MemoryEventHandler):
+    """Test spill store: holds releasable reservations, frees one per
+    on_alloc_failure call (the plugin's spill-framework shape)."""
+
+    def __init__(self, budget_getter):
+        self._get_budget = budget_getter
+        self.spillable = []
+        self.spills = 0
+        self.alloc_cbs = 0
+        self.dealloc_cbs = 0
+
+    def on_alloc_failure(self, nbytes, retry_count):
+        if not self.spillable:
+            return False
+        self.spills += 1
+        self._get_budget().release(self.spillable.pop())
+        return True
+
+    def on_allocated(self, total_used):
+        self.alloc_cbs += 1
+
+    def on_deallocated(self, total_used):
+        self.dealloc_cbs += 1
+
+
+def test_spill_handler_frees_before_blocking():
+    store = SpillStore(lambda: session.device)
+    session = DeviceSession(device_limit_bytes=1000, watchdog=False,
+                            event_handler=store)
+    with session:
+        session.arbiter.current_thread_is_dedicated_to_task(1)
+        store.spillable.append(session.device.acquire(600))
+        store.spillable.append(session.device.acquire(300))
+        # 800 doesn't fit (900 used) -> handler spills until it does; the
+        # thread never blocks and no retry is recorded
+        r = session.device.acquire(800)
+        assert store.spills >= 1
+        assert session.device.used <= 1000
+        session.device.release(r)
+        assert session.arbiter.get_and_reset_num_retry_throw(1) == 0
+        session.arbiter.task_done(1)
+    assert store.alloc_cbs >= 3 and store.dealloc_cbs >= 1
+
+
+def test_spill_handler_exhausted_falls_through():
+    store = SpillStore(lambda: session.device)
+    session = DeviceSession(device_limit_bytes=100, watchdog=False,
+                            event_handler=store)
+    with session:
+        session.arbiter.current_thread_is_dedicated_to_task(2)
+        held = session.device.acquire(90)
+        # nothing spillable -> the handler declines and the request falls
+        # through to the task-level state machine, which throws RetryOOM
+        # (caller must make inputs spillable and retry — RmmSpark.java:402)
+        with pytest.raises(RetryOOM):
+            session.device.acquire(50)
+        session.device.release(held)
+        session.arbiter.task_done(2)
