@@ -187,3 +187,23 @@ def test_format_huge_exponent():
     [got] = format_float(Column.from_numpy(vals, dtypes.FLOAT64), 2).to_pylist()
     assert got == spark_format_number(1e300, 2, False)
     assert len(got) == 404
+
+
+def test_format_reference_gtest_vectors():
+    """format_float.cpp:29-91 vectors, bit-exact (incl. NaN -> U+FFFD and
+    thousands grouping)."""
+    f32 = np.array([100.0, 654321.25, -12761.125, 0.0, 5.0, -4.0, np.nan,
+                    123456789012.34, -0.0], np.float32)
+    got = format_float(Column.from_numpy(f32), 5).to_pylist()
+    assert got == ["100.00000", "654,321.25000", "-12,761.12500", "0.00000",
+                   "5.00000", "-4.00000", "�",
+                   "123,456,790,000.00000", "-0.00000"]
+    f64 = np.array([100.0, 654321.25, -12761.125, 1.123456789123456789,
+                    0.000000000000000000123456789123456789, 0.0, 5.0, -4.0,
+                    np.nan, 839542223232.794248339, 3232.794248339,
+                    11234000000.0, -0.0], np.float64)
+    got = format_float(Column.from_numpy(f64), 5).to_pylist()
+    assert got == ["100.00000", "654,321.25000", "-12,761.12500", "1.12346",
+                   "0.00000", "0.00000", "5.00000", "-4.00000", "�",
+                   "839,542,223,232.79420", "3,232.79425",
+                   "11,234,000,000.00000", "-0.00000"]
