@@ -10,7 +10,9 @@
 // Arrow-layout buffers (values + validity + offsets) ready for device_put.
 //
 // Scope: flat (non-nested) schemas; PLAIN / RLE / PLAIN_DICTIONARY /
-// RLE_DICTIONARY encodings; DataPage v1+v2; UNCOMPRESSED / SNAPPY / GZIP /
+// RLE_DICTIONARY / DELTA_BINARY_PACKED / DELTA_LENGTH_BYTE_ARRAY /
+// DELTA_BYTE_ARRAY / BYTE_STREAM_SPLIT encodings; DataPage v1+v2;
+// UNCOMPRESSED / SNAPPY / GZIP /
 // ZSTD codecs. Physical types BOOLEAN, INT32, INT64, INT96, FLOAT, DOUBLE,
 // BYTE_ARRAY, FIXED_LEN_BYTE_ARRAY.
 //
@@ -419,7 +421,19 @@ void rle_decode(uint8_t const* p, uint8_t const* end, int bit_width,
 // pages, e.g. Spark with parquet.writer.version=v2) ----------------------
 
 // raw LSB-first bit-unpack (miniblock payload; not the RLE-hybrid form)
-inline uint64_t read_bits_at(uint8_t const* base, uint64_t bit_off, int w) {
+// `avail` = bytes readable from base; the 8-byte fast path is only taken
+// when the full word load stays inside the buffer (a miniblock can end at
+// the very end of a caller-borrowed mmap)
+inline uint64_t read_bits_at(uint8_t const* base, uint64_t avail,
+                             uint64_t bit_off, int w) {
+  int const shift = int(bit_off & 7);
+  uint64_t const byte0 = bit_off >> 3;
+  if (w + shift <= 64 && byte0 + 8 <= avail) {
+    uint64_t word;
+    std::memcpy(&word, base + byte0, 8);
+    uint64_t mask = (w == 64) ? ~uint64_t(0) : ((uint64_t(1) << w) - 1);
+    return (word >> shift) & mask;
+  }
   uint64_t v = 0;
   for (int b = 0; b < w; b++) {
     uint64_t bit = bit_off + b;
@@ -447,7 +461,10 @@ void delta_binary_unpack(uint8_t const*& pp, uint8_t const* end,
   // check (real writers use per_mb <= a few thousand)
   if (per_mb > (UINT64_MAX - 7) / 64)
     throw std::runtime_error("parquet: bad delta header");
-  vals.reserve(vals.size() + total);
+  // clamp the reserve by the input size: a crafted header's total could
+  // otherwise request a terabyte allocation from a 20-byte page
+  vals.reserve(vals.size() +
+               size_t(std::min<uint64_t>(total, uint64_t(end - r.p) * 8 + 1)));
   uint64_t produced = 0;
   uint64_t cur = uint64_t(first);
   if (total) { vals.push_back(first); produced = 1; }
@@ -464,7 +481,8 @@ void delta_binary_unpack(uint8_t const*& pp, uint8_t const* end,
       if (uint64_t(end - r.p) < nbytes)
         throw std::runtime_error("parquet: delta eof");
       for (uint64_t i = 0; i < per_mb && produced < total; i++) {
-        uint64_t packed = w ? read_bits_at(r.p, i * uint64_t(w), w) : 0;
+        uint64_t packed =
+            w ? read_bits_at(r.p, uint64_t(end - r.p), i * uint64_t(w), w) : 0;
         cur += uint64_t(min_delta) + packed;
         vals.push_back(int64_t(cur));
         produced++;
@@ -625,6 +643,23 @@ void decode_delta_binary(int32_t pt, uint8_t const* p, uint8_t const* end,
   }
 }
 
+// BYTE_STREAM_SPLIT: w byte-streams of `count` bytes; byte j of value i
+// lives at stream j offset i (improves float compressibility)
+void decode_byte_stream_split(int32_t pt, int32_t type_length,
+                              uint8_t const* p, uint8_t const* end,
+                              int64_t count, DecodedChunk& out) {
+  int w = phys_width(pt, type_length);
+  if (w <= 0)
+    throw std::runtime_error("parquet: BYTE_STREAM_SPLIT on variable type");
+  if (end - p < count * w)
+    throw std::runtime_error("parquet: byte-stream-split eof");
+  size_t off = out.values.size();
+  out.values.resize(off + size_t(count) * size_t(w));
+  for (int j = 0; j < w; j++)
+    for (int64_t i = 0; i < count; i++)
+      out.values[off + size_t(i) * w + j] = p[size_t(j) * count + size_t(i)];
+}
+
 // DELTA_LENGTH_BYTE_ARRAY: delta-packed lengths, then concatenated bytes
 void decode_delta_length_byte_array(int32_t pt, uint8_t const* p,
                                     uint8_t const* end, int64_t count,
@@ -647,7 +682,8 @@ void decode_delta_length_byte_array(int32_t pt, uint8_t const* p,
 
 // DELTA_BYTE_ARRAY: prefix lengths + suffix lengths (both delta-packed),
 // then concatenated suffixes; value = previous[:prefix] + suffix
-void decode_delta_byte_array(int32_t pt, uint8_t const* p, uint8_t const* end,
+void decode_delta_byte_array(int32_t pt, int32_t type_length,
+                             uint8_t const* p, uint8_t const* end,
                              int64_t count, DecodedChunk& out) {
   if (pt != PT_BYTE_ARRAY && pt != PT_FLBA)
     throw std::runtime_error("parquet: DELTA_BYTE_ARRAY on non-binary");
@@ -671,6 +707,11 @@ void decode_delta_byte_array(int32_t pt, uint8_t const* p, uint8_t const* end,
                 size_t(pl));
     std::memcpy(out.values.data() + off + size_t(pl), p, size_t(sl));
     p += sl;
+    if (pt == PT_FLBA && pl + sl != int64_t(type_length))
+      // a fixed-width column's values buffer is consumed as count*width
+      // bytes downstream; one short value would silently shift every
+      // later value
+      throw std::runtime_error("parquet: delta FLBA length mismatch");
     out.lengths.push_back(int32_t(pl + sl));
     prev_off = off;
     prev_len = pl + sl;
@@ -846,7 +887,12 @@ DecodedChunk decode_chunk(FileState const& st, ChunkMeta const& cm,
         decode_delta_length_byte_array(leaf.phys_type, vp, vend, present, out);
         break;
       case 7:                               // DELTA_BYTE_ARRAY
-        decode_delta_byte_array(leaf.phys_type, vp, vend, present, out);
+        decode_delta_byte_array(leaf.phys_type, leaf.type_length, vp, vend,
+                                present, out);
+        break;
+      case 9:                               // BYTE_STREAM_SPLIT
+        decode_byte_stream_split(leaf.phys_type, leaf.type_length, vp, vend,
+                                 present, out);
         break;
       default:
         throw std::runtime_error("parquet: unsupported encoding " +

@@ -218,3 +218,20 @@ def test_delta_encodings(tmp_path):
         got = read_parquet(path)
         for name in ("i32", "i64", "s"):
             assert got[name].to_pylist() == t.column(name).to_pylist(), name
+
+
+def test_byte_stream_split(tmp_path):
+    rng = np.random.default_rng(1)
+    n = 3000
+    t = pa.table({
+        "f": pa.array(rng.standard_normal(n).astype(np.float32)),
+        "d": pa.array(rng.standard_normal(n)),
+    })
+    path = str(tmp_path / "bss.parquet")
+    pq.write_table(t, path, use_dictionary=False, use_byte_stream_split=True,
+                   compression="ZSTD", row_group_size=777)
+    got = read_parquet(path)
+    np.testing.assert_array_equal(np.asarray(got["f"].data),
+                                  t.column("f").to_numpy())
+    np.testing.assert_array_equal(np.asarray(got["d"].data),
+                                  t.column("d").to_numpy())
