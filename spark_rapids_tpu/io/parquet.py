@@ -71,6 +71,16 @@ def _native():
                     ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
                     ctypes.c_void_p, ctypes.c_void_p,
                     ctypes.POINTER(ctypes.c_int64)]
+                lib.pqr_leaf_is_list.argtypes = [ctypes.c_void_p,
+                                                 ctypes.c_int32]
+                lib.pqr_read_list_column.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+                    ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+                    ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.POINTER(ctypes.c_int64),
+                    ctypes.POINTER(ctypes.c_int64),
+                    ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.POINTER(ctypes.c_int64)]
                 lib.pqr_free.argtypes = [ctypes.c_void_p]
                 _lib = lib
     return _lib
@@ -78,11 +88,15 @@ def _native():
 
 class _Leaf:
     def __init__(self, idx, name, phys, type_length, converted, scale,
-                 precision, optional, flat):
+                 precision, optional, flat, is_list=False):
         self.idx, self.name, self.phys = idx, name, phys
         self.type_length, self.converted = type_length, converted
         self.scale, self.precision = scale, precision
         self.optional, self.flat = optional, flat
+        self.is_list = is_list
+        # LIST leaves carry the 3-level dotted path (f.list.element); the
+        # user-facing column name is the outer field
+        self.display = name.split(".")[0] if is_list else name
 
     def dtype(self) -> dtypes.DType:
         if self.phys == _PT_BOOLEAN:
@@ -146,7 +160,7 @@ class ParquetChunkedReader:
             raise ValueError(self._lib.pqr_last_error().decode())
         self._leaves = self._read_schema()
         if columns is not None:
-            by_name = {l.name: l for l in self._leaves}
+            by_name = {l.display: l for l in self._leaves}
             missing = [c for c in columns if c not in by_name]
             if missing:
                 raise KeyError(f"columns not in file: {missing}")
@@ -166,13 +180,14 @@ class ParquetChunkedReader:
             if rc != 0:
                 raise ValueError("schema read failed")
             phys, tl, conv, scale, prec, opt, flat = (x.value for x in ints)
+            is_list = self._lib.pqr_leaf_is_list(self._h, i) == 1
             out.append(_Leaf(i, buf.value.decode(), phys, tl, conv, scale,
-                             prec, bool(opt), bool(flat)))
-        return [l for l in out if l.flat]
+                             prec, bool(opt), bool(flat), is_list))
+        return [l for l in out if l.flat or l.is_list]
 
     @property
     def column_names(self) -> List[str]:
-        return [l.name for l in self._leaves]
+        return [l.display for l in self._leaves]
 
     def has_next(self) -> bool:
         return self._next_group < self.num_row_groups
@@ -198,14 +213,21 @@ class ParquetChunkedReader:
         return _concat_tables(chunks)
 
     def _empty_column(self, leaf: _Leaf) -> Column:
-        return _assemble(leaf, np.zeros(0, np.uint8), np.zeros(0, np.int32),
+        import jax.numpy as jnp
+        elem = _assemble(leaf, np.zeros(0, np.uint8), np.zeros(0, np.int32),
                          np.ones(0, np.uint8), 0, 0)
+        if not leaf.is_list:
+            return elem
+        return Column.make_list(jnp.asarray(np.zeros(1, np.int32)), elem)
 
     def _read_group(self, rg: int) -> Table:
         import jax.numpy as jnp  # noqa: F401  (Column builds device arrays)
         n_rows = self._lib.pqr_row_group_num_rows(self._h, rg)
         cols = []
         for leaf in self._leaves:
+            if leaf.is_list:
+                cols.append(self._read_list_chunk(rg, leaf, n_rows))
+                continue
             nbytes = ctypes.c_int64()
             present = ctypes.c_int64()
             rc = self._lib.pqr_read_column(self._h, rg, leaf.idx, None,
@@ -228,6 +250,46 @@ class ParquetChunkedReader:
                                   lengths[:present.value],
                                   defined[:n_rows], n_rows, present.value))
         return Table(cols, names=self.column_names)
+
+    def _read_list_chunk(self, rg: int, leaf: _Leaf, n_rows: int) -> Column:
+        import jax.numpy as jnp
+        nbytes = ctypes.c_int64()
+        present = ctypes.c_int64()
+        slots = ctypes.c_int64()
+        rows = ctypes.c_int64()
+
+        def call(values, lengths, defined, counts, valid):
+            return self._lib.pqr_read_list_column(
+                self._h, rg, leaf.idx, values, ctypes.byref(nbytes),
+                lengths, defined, ctypes.byref(slots), ctypes.byref(present),
+                counts, valid, ctypes.byref(rows))
+
+        if call(None, None, None, None, None) != 0:
+            raise ValueError(self._lib.pqr_last_error().decode())
+        values = np.zeros(max(nbytes.value, 1), np.uint8)
+        lengths = np.zeros(max(present.value, 1), np.int32)
+        defined = np.zeros(max(slots.value, 1), np.uint8)
+        counts = np.zeros(max(rows.value, 1), np.int32)
+        valid = np.zeros(max(rows.value, 1), np.uint8)
+        rc = call(values.ctypes.data_as(ctypes.c_void_p),
+                  lengths.ctypes.data_as(ctypes.c_void_p),
+                  defined.ctypes.data_as(ctypes.c_void_p),
+                  counts.ctypes.data_as(ctypes.c_void_p),
+                  valid.ctypes.data_as(ctypes.c_void_p))
+        if rc != 0:
+            raise ValueError(self._lib.pqr_last_error().decode())
+        if rows.value != n_rows:
+            raise ValueError(
+                f"list column {leaf.display!r}: row count mismatch "
+                f"({rows.value} vs {n_rows})")
+        elem = _assemble(leaf, values[:nbytes.value],
+                         lengths[:present.value], defined[:slots.value],
+                         int(slots.value), int(present.value))
+        offsets = np.zeros(n_rows + 1, np.int32)
+        np.cumsum(counts[:n_rows], out=offsets[1:])
+        validity = (jnp.asarray(valid[:n_rows] != 0)
+                    if (valid[:n_rows] == 0).any() else None)
+        return Column.make_list(jnp.asarray(offsets), elem, validity)
 
     def close(self) -> None:
         if self._h:

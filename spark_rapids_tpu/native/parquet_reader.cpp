@@ -9,7 +9,9 @@
 // decode hot path lives here as native host code and hands the TPU dense
 // Arrow-layout buffers (values + validity + offsets) ready for device_put.
 //
-// Scope: flat (non-nested) schemas; PLAIN / RLE / PLAIN_DICTIONARY /
+// Scope: flat schemas + standard 3-level LIST<primitive> (Spark array
+// columns; MAP/LIST<STRUCT>/STRUCT shapes are skipped, never mis-surfaced);
+// PLAIN / RLE / PLAIN_DICTIONARY /
 // RLE_DICTIONARY / DELTA_BINARY_PACKED / DELTA_LENGTH_BYTE_ARRAY /
 // DELTA_BYTE_ARRAY / BYTE_STREAM_SPLIT encodings; DataPage v1+v2;
 // UNCOMPRESSED / SNAPPY / GZIP /
@@ -150,7 +152,13 @@ struct LeafSchema {
   int32_t converted = -1;   // ConvertedType enum (UTF8=0, DATE=6, ...)
   int32_t scale = 0, precision = 0;
   bool optional = false;
-  bool flat = true;         // false if nested under a group (unsupported)
+  bool flat = true;         // top-level non-repeated primitive
+  // repetition/definition structure (Dremel levels)
+  int32_t max_def = 0;
+  int32_t max_rep = 0;
+  int32_t def_at_repeated = 0;  // cumulative def at the repeated node (lists)
+  bool is_list = false;         // standard LIST shape: exactly one repeated
+                                // ancestor over a primitive leaf
 };
 
 struct ChunkMeta {
@@ -215,22 +223,60 @@ void parse_schema(TReader& r, std::vector<LeafSchema>& leaves) {
     elems.push_back(std::move(e));
   });
   if (elems.empty()) throw std::runtime_error("parquet: empty schema");
-  // walk the tree depth-first to find leaves + whether they sit at depth 1
+  // depth-first walk tracking Dremel levels: optional adds a definition
+  // level, repeated adds one definition AND one repetition level. Parent
+  // indices are recorded so the LIST-shape check below can inspect the
+  // exact ancestry (a lone max_rep==1 test would also match MAP leaves,
+  // LIST<STRUCT> members and STRUCT<LIST> fields).
   size_t pos = 1;
-  struct Frame { int32_t remaining; int depth; };
-  std::vector<Frame> stack{{elems[0].num_children, 0}};
+  struct Frame {
+    int32_t remaining;
+    int32_t def_level, rep_level;
+    int32_t def_at_repeated;   // def at the innermost repeated ancestor
+    std::string path;
+    int32_t elem_idx;          // index into elems (-1 for root)
+    int depth;
+  };
+  std::vector<Frame> stack{{elems[0].num_children, 0, 0, -1, "", 0, 0}};
   while (pos < elems.size() && !stack.empty()) {
     while (!stack.empty() && stack.back().remaining == 0) stack.pop_back();
     if (stack.empty()) break;
     stack.back().remaining--;
     Elem& e = elems[pos++];
+    size_t const cur_idx = pos - 1;
+    Frame const& top = stack.back();
     int depth = int(stack.size());
+    int32_t def = top.def_level + (e.repetition != 0 ? 1 : 0);
+    int32_t rep = top.rep_level + (e.repetition == 2 ? 1 : 0);
+    int32_t dar = (e.repetition == 2) ? def : top.def_at_repeated;
+    std::string path =
+        top.path.empty() ? e.leaf.name : top.path + "." + e.leaf.name;
     if (e.is_group) {
-      stack.push_back({e.num_children, depth});
+      stack.push_back({e.num_children, def, rep, dar, path,
+                       int32_t(cur_idx), depth});
     } else {
       LeafSchema leaf = e.leaf;
+      leaf.name = path;
       leaf.optional = e.repetition == 1;   // 0 required, 1 optional, 2 repeated
       leaf.flat = depth == 1 && e.repetition != 2;
+      leaf.max_def = def;
+      leaf.max_rep = rep;
+      leaf.def_at_repeated = dar;
+      // standard 3-level LIST over a primitive, and nothing else: the direct
+      // parent is the repeated group with this leaf as its only child, the
+      // grandparent is a top-level single-child group annotated LIST
+      // (ConvertedType LIST == 3); MAP key_value groups (2 children) and
+      // LIST<STRUCT> (parent is a struct group) fail these tests
+      leaf.is_list = false;
+      if (rep == 1 && e.repetition != 2 && stack.size() >= 3) {
+        Frame const& parent = stack[stack.size() - 1];
+        Frame const& grand = stack[stack.size() - 2];
+        Elem const& pe = elems[size_t(parent.elem_idx)];
+        Elem const& ge = elems[size_t(grand.elem_idx)];
+        leaf.is_list = pe.repetition == 2 && pe.num_children == 1 &&
+                       grand.depth == 1 && ge.num_children == 1 &&
+                       ge.leaf.converted == 3 && ge.repetition != 2;
+      }
       leaves.push_back(std::move(leaf));
     }
   }
@@ -575,9 +621,18 @@ PageHeader read_page_header(TReader& r) {
 struct DecodedChunk {
   std::vector<uint8_t> values;    // fixed width: num_valid * width; strings: chars
   std::vector<int32_t> lengths;   // strings: per present value
-  std::vector<uint8_t> defined;   // per row 0/1 (all 1 when required)
-  int64_t num_rows = 0;
+  std::vector<uint8_t> defined;   // per row (flat) / per element slot (list)
+  int64_t num_rows = 0;           // rows (rep==0 entries for list chunks)
+  // list chunks only (leaf.is_list):
+  std::vector<int32_t> list_counts;  // element slots per row
+  std::vector<uint8_t> list_valid;   // per-row list validity
 };
+
+inline int level_bit_width(int32_t max_level) {
+  int w = 0;
+  while ((1 << w) <= max_level) w++;   // values 0..max_level
+  return max_level ? w : 0;
+}
 
 struct Dict {
   std::vector<uint8_t> fixed;     // fixed-width values
@@ -801,35 +856,48 @@ DecodedChunk decode_chunk(FileState const& st, ChunkMeta const& cm,
     }
 
     std::vector<int32_t> defs;
+    std::vector<int32_t> reps;
     std::vector<uint8_t> plain;
     uint8_t const* vp;
     uint8_t const* vend;
     int64_t page_values = h.num_values;
+    int const bw_def = level_bit_width(leaf.max_def);
+    int const bw_rep = level_bit_width(leaf.max_rep);
 
     if (h.type == 0) {                      // data page v1
       plain = decompress(cm.codec, body, size_t(h.compressed_size),
                          size_t(h.uncompressed_size));
       uint8_t const* p = plain.data();
       uint8_t const* pe = p + plain.size();
-      if (leaf.optional) {
-        if (pe - p < 4) throw std::runtime_error("parquet: def eof");
+      auto v1_levels = [&](int bw, std::vector<int32_t>& out_levels) {
+        if (pe - p < 4) throw std::runtime_error("parquet: level eof");
         uint32_t dl;
         std::memcpy(&dl, p, 4);
         p += 4;
-        if (uint64_t(pe - p) < dl) throw std::runtime_error("parquet: def eof");
-        defs.resize(page_values);
-        rle_decode(p, p + dl, 1, page_values, defs.data());
+        if (uint64_t(pe - p) < dl) throw std::runtime_error("parquet: level eof");
+        out_levels.resize(page_values);
+        rle_decode(p, p + dl, bw, page_values, out_levels.data());
         p += dl;
-      }
+      };
+      if (bw_rep) v1_levels(bw_rep, reps);   // rep levels precede def levels
+      if (bw_def) v1_levels(bw_def, defs);
       vp = p;
       vend = pe;
     } else if (h.type == 3) {               // data page v2
       uint8_t const* p = body;
-      if (h.rep_len)
-        throw std::runtime_error("parquet: repeated fields unsupported");
+      if (h.rep_len < 0 || h.def_len < 0 ||
+          int64_t(h.rep_len) + h.def_len > h.compressed_size)
+        throw std::runtime_error("parquet: bad v2 level lengths");
+      if (h.rep_len) {
+        if (!bw_rep)
+          throw std::runtime_error("parquet: unexpected repetition levels");
+        reps.resize(page_values);
+        rle_decode(p, p + h.rep_len, bw_rep, page_values, reps.data());
+      }
       if (h.def_len) {
         defs.resize(page_values);
-        rle_decode(p, p + h.def_len, 1, page_values, defs.data());
+        rle_decode(p + h.rep_len, p + h.rep_len + h.def_len, bw_def,
+                   page_values, defs.data());
       }
       p += h.def_len + h.rep_len;
       int64_t data_comp = h.compressed_size - h.def_len - h.rep_len;
@@ -847,11 +915,38 @@ DecodedChunk decode_chunk(FileState const& st, ChunkMeta const& cm,
     }
 
     int64_t present = page_values;
-    if (!defs.empty()) {
+    int64_t page_rows = page_values;
+    if (leaf.is_list) {
+      // Dremel reassembly, one repeated level: rep==0 starts a row;
+      // def >= def_at_repeated means an element slot exists; def == max_def
+      // means the element is non-null; def == def_at_repeated-1 is an empty
+      // list; lower means the list (or an outer optional) is null
+      if (defs.empty() || reps.empty())
+        throw std::runtime_error("parquet: list page missing levels");
+      int32_t const dar = leaf.def_at_repeated;
+      present = 0;
+      page_rows = 0;
+      for (int64_t i = 0; i < page_values; i++) {
+        if (reps[i] == 0) {
+          page_rows++;
+          out.list_counts.push_back(0);
+          out.list_valid.push_back(uint8_t(defs[i] >= dar - 1));
+        }
+        if (out.list_counts.empty())
+          throw std::runtime_error("parquet: page starts mid-row");
+        if (defs[i] >= dar) {
+          out.list_counts.back()++;
+          bool def_full = defs[i] == leaf.max_def;
+          out.defined.push_back(uint8_t(def_full));
+          if (def_full) present++;
+        }
+      }
+    } else if (!defs.empty()) {
       present = 0;
       for (int64_t i = 0; i < page_values; i++) {
-        out.defined.push_back(uint8_t(defs[i]));
-        if (defs[i]) present++;
+        bool d = defs[i] == leaf.max_def;
+        out.defined.push_back(uint8_t(d));
+        if (d) present++;
       }
     } else {
       out.defined.insert(out.defined.end(), size_t(page_values), uint8_t(1));
@@ -899,7 +994,7 @@ DecodedChunk decode_chunk(FileState const& st, ChunkMeta const& cm,
                                  std::to_string(h.encoding));
     }
     remaining -= page_values;
-    out.num_rows += page_values;
+    out.num_rows += page_rows;
   }
   return out;
 }
@@ -953,6 +1048,89 @@ int64_t pqr_row_group_num_rows(void* h, int32_t rg) {
 }
 
 // leaf schema accessors: name into caller buffer; ints via out params
+// Shared lookup + size-then-fill cache protocol for both column entry
+// points: the sizing call (fill=false) caches the decode, the fill call
+// consumes it — chunks are never decompressed twice.
+std::shared_ptr<DecodedChunk> get_chunk(FileState* st, int32_t rg,
+                                        int32_t leaf, bool fill) {
+  if (rg < 0 || size_t(rg) >= st->groups.size())
+    throw std::runtime_error("row group out of range");
+  auto const& grp = st->groups[rg];
+  ChunkMeta const* cm = nullptr;
+  for (auto const& c : grp.chunks)
+    if (c.schema_idx == leaf) { cm = &c; break; }
+  if (!cm) throw std::runtime_error("column chunk not found");
+  auto key = std::make_pair(rg, leaf);
+  std::shared_ptr<DecodedChunk> dcp;
+  {
+    std::lock_guard<std::mutex> lk(st->cache_mu);
+    auto it = st->cache.find(key);
+    if (it != st->cache.end()) {
+      dcp = it->second;
+      if (fill) st->cache.erase(it);
+    }
+  }
+  if (!dcp) {
+    dcp = std::make_shared<DecodedChunk>(
+        decode_chunk(*st, *cm, st->leaves[leaf]));
+    if (!fill) {
+      std::lock_guard<std::mutex> lk(st->cache_mu);
+      st->cache[key] = dcp;
+    }
+  }
+  return dcp;
+}
+
+int32_t pqr_leaf_is_list(void* h, int32_t i) {
+  auto* st = static_cast<FileState*>(h);
+  if (i < 0 || size_t(i) >= st->leaves.size()) return -1;
+  return st->leaves[i].is_list ? 1 : 0;
+}
+
+// Two-phase read of a LIST<primitive> column chunk (standard 3-level shape).
+// Sizing call (values==nullptr) fills *values_nbytes, *num_present,
+// *num_elem_slots and *num_rows; the fill call populates values, lengths
+// (strings; per present value), elem_defined (num_elem_slots bytes),
+// row_counts (num_rows int32) and row_valid (num_rows bytes).
+int32_t pqr_read_list_column(void* h, int32_t rg, int32_t leaf,
+                             uint8_t* values, int64_t* values_nbytes,
+                             int32_t* lengths, uint8_t* elem_defined,
+                             int64_t* num_elem_slots, int64_t* num_present,
+                             int32_t* row_counts, uint8_t* row_valid,
+                             int64_t* num_rows) {
+  auto* st = static_cast<FileState*>(h);
+  try {
+    if (leaf < 0 || size_t(leaf) >= st->leaves.size())
+      throw std::runtime_error("leaf out of range");
+    if (!st->leaves[leaf].is_list)
+      throw std::runtime_error("not a list column");
+    auto dcp = get_chunk(st, rg, leaf, values != nullptr);
+    DecodedChunk const& dc = *dcp;
+    int64_t present = 0;
+    for (uint8_t d : dc.defined) present += d;
+    *values_nbytes = int64_t(dc.values.size());
+    *num_present = present;
+    *num_elem_slots = int64_t(dc.defined.size());
+    *num_rows = dc.num_rows;
+    if (!values) return 0;
+    std::memcpy(values, dc.values.data(), dc.values.size());
+    if (lengths && !dc.lengths.empty())
+      std::memcpy(lengths, dc.lengths.data(),
+                  dc.lengths.size() * sizeof(int32_t));
+    if (elem_defined && !dc.defined.empty())
+      std::memcpy(elem_defined, dc.defined.data(), dc.defined.size());
+    if (row_counts && !dc.list_counts.empty())
+      std::memcpy(row_counts, dc.list_counts.data(),
+                  dc.list_counts.size() * sizeof(int32_t));
+    if (row_valid && !dc.list_valid.empty())
+      std::memcpy(row_valid, dc.list_valid.data(), dc.list_valid.size());
+    return 0;
+  } catch (std::exception const& e) {
+    g_error = e.what();
+    return -1;
+  }
+}
+
 int32_t pqr_leaf_info(void* h, int32_t i, char* name_out, int32_t name_cap,
                       int32_t* phys_type, int32_t* type_length,
                       int32_t* converted, int32_t* scale, int32_t* precision,
@@ -982,36 +1160,14 @@ int32_t pqr_read_column(void* h, int32_t rg, int32_t leaf,
                         int64_t* num_present) {
   auto* st = static_cast<FileState*>(h);
   try {
-    if (rg < 0 || size_t(rg) >= st->groups.size())
-      throw std::runtime_error("row group out of range");
-    auto const& grp = st->groups[rg];
-    ChunkMeta const* cm = nullptr;
-    for (auto const& c : grp.chunks)
-      if (c.schema_idx == leaf) { cm = &c; break; }
-    if (!cm) throw std::runtime_error("column chunk not found");
+    if (leaf < 0 || size_t(leaf) >= st->leaves.size())
+      throw std::runtime_error("leaf out of range");
     auto const& lf = st->leaves[leaf];
     if (!lf.flat)
-      throw std::runtime_error("nested/repeated columns unsupported");
-
-    // one decode per (rg, leaf): the sizing call caches, the fill call
-    // consumes (so chunks are never decompressed twice)
-    auto key = std::make_pair(rg, leaf);
-    std::shared_ptr<DecodedChunk> dcp;
-    {
-      std::lock_guard<std::mutex> lk(st->cache_mu);
-      auto it = st->cache.find(key);
-      if (it != st->cache.end()) {
-        dcp = it->second;
-        if (values) st->cache.erase(it);
-      }
-    }
-    if (!dcp) {
-      dcp = std::make_shared<DecodedChunk>(decode_chunk(*st, *cm, lf));
-      if (!values) {
-        std::lock_guard<std::mutex> lk(st->cache_mu);
-        st->cache[key] = dcp;
-      }
-    }
+      throw std::runtime_error(
+          lf.is_list ? "list column: use pqr_read_list_column"
+                     : "nested/repeated columns unsupported");
+    auto dcp = get_chunk(st, rg, leaf, values != nullptr);
     DecodedChunk const& dc = *dcp;
     int64_t present = 0;
     for (uint8_t d : dc.defined) present += d;

@@ -42,8 +42,17 @@ def _concat2(a: Column, b: Column) -> Column:
         offsets = jnp.concatenate([a.offsets, off_b.astype(jnp.int32)])
         return Column(dtype=a.dtype, length=n, data=chars,
                       offsets=offsets, validity=validity)
-    if a.dtype.kind in (Kind.LIST, Kind.STRUCT):
-        raise TypeError("nested concat is not supported")
+    if a.dtype.kind == Kind.LIST:
+        child = _concat2(a.children[0], b.children[0])
+        off_b = b.offsets[1:] + a.offsets[-1]
+        offsets = jnp.concatenate([a.offsets, off_b.astype(jnp.int32)])
+        return Column(dtype=a.dtype, length=n, offsets=offsets,
+                      children=(child,), validity=validity)
+    if a.dtype.kind == Kind.STRUCT:
+        children = tuple(_concat2(ca, cb)
+                         for ca, cb in zip(a.children, b.children))
+        return Column(dtype=a.dtype, length=n, children=children,
+                      validity=validity)
     return Column(dtype=a.dtype, length=n,
                   data=jnp.concatenate([a.data, b.data]), validity=validity)
 

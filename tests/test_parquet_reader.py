@@ -235,3 +235,73 @@ def test_byte_stream_split(tmp_path):
                                   t.column("f").to_numpy())
     np.testing.assert_array_equal(np.asarray(got["d"].data),
                                   t.column("d").to_numpy())
+
+
+class TestListColumns:
+    """Standard 3-level LIST<primitive> decoding (Spark array columns):
+    null list vs empty list vs null element, across page versions, codecs,
+    dictionary and delta encodings."""
+
+    ROWS_I = [[1, 2, 3], None, [], [4, None, 6], [7]] * 400
+    ROWS_S = [["a", "bb"], [], None, [None, "ccc"], ["d"]] * 400
+
+    def _table(self):
+        return pa.table({
+            "li": pa.array(self.ROWS_I, pa.list_(pa.int64())),
+            "ls": pa.array(self.ROWS_S, pa.list_(pa.utf8())),
+            "flat": pa.array(range(len(self.ROWS_I))),
+        })
+
+    @pytest.mark.parametrize("kw", [
+        dict(version="1.0", compression="SNAPPY"),
+        dict(version="2.6", compression="ZSTD"),
+        dict(data_page_version="2.0"),
+        dict(use_dictionary=False, data_page_version="2.0",
+             column_encoding={"li": "DELTA_BINARY_PACKED",
+                              "ls": "DELTA_BYTE_ARRAY",
+                              "flat": "DELTA_BINARY_PACKED"}),
+    ])
+    def test_round_trip(self, tmp_path, kw):
+        path = str(tmp_path / "lists.parquet")
+        pq.write_table(self._table(), path, row_group_size=777, **kw)
+        got = read_parquet(path)
+        assert list(got.names) == ["li", "ls", "flat"]
+        assert got["li"].to_pylist() == self.ROWS_I
+        assert got["ls"].to_pylist() == self.ROWS_S
+        assert got["flat"].to_pylist() == list(range(len(self.ROWS_I)))
+
+    def test_column_selection_by_outer_name(self, tmp_path):
+        path = str(tmp_path / "sel.parquet")
+        pq.write_table(self._table(), path)
+        got = read_parquet(path, columns=["ls"])
+        assert list(got.names) == ["ls"]
+        assert got["ls"].to_pylist() == self.ROWS_S
+
+    def test_required_elements(self, tmp_path):
+        t = pa.table({"l": pa.array([[1], [2, 3], []],
+                                    pa.list_(pa.field("item", pa.int32(),
+                                                      nullable=False)))})
+        path = str(tmp_path / "req.parquet")
+        pq.write_table(t, path)
+        got = read_parquet(path)
+        assert got["l"].to_pylist() == [[1], [2, 3], []]
+
+
+def test_map_and_nested_struct_shapes_excluded_not_corrupted(tmp_path):
+    """MAP, LIST<STRUCT> and STRUCT<LIST> leaves must be skipped entirely —
+    a loose is_list test would surface them as wrong columns."""
+    t = pa.table({
+        "m": pa.array([{"a": 1}, {"b": 2}], pa.map_(pa.utf8(), pa.int64())),
+        "lstruct": pa.array([[{"x": 1}], []],
+                            pa.list_(pa.struct([("x", pa.int64())]))),
+        "slist": pa.array([{"v": [1, 2]}, {"v": []}],
+                          pa.struct([("v", pa.list_(pa.int64()))])),
+        "ok": pa.array([10, 20]),
+        "larr": pa.array([[1, 2], [3]], pa.list_(pa.int64())),
+    })
+    path = str(tmp_path / "mixed.parquet")
+    pq.write_table(t, path)
+    got = read_parquet(path)
+    assert list(got.names) == ["ok", "larr"]
+    assert got["ok"].to_pylist() == [10, 20]
+    assert got["larr"].to_pylist() == [[1, 2], [3]]
