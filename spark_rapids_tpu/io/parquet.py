@@ -71,8 +71,14 @@ def _native():
                     ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
                     ctypes.c_void_p, ctypes.c_void_p,
                     ctypes.POINTER(ctypes.c_int64)]
-                lib.pqr_leaf_is_list.argtypes = [ctypes.c_void_p,
-                                                 ctypes.c_int32]
+                lib.pqr_leaf_kind.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+                lib.pqr_leaf_struct_info.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int32,
+                    ctypes.POINTER(ctypes.c_int32),
+                    ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+                lib.pqr_read_def_levels.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+                    ctypes.c_void_p]
                 lib.pqr_read_list_column.argtypes = [
                     ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
                     ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
@@ -88,15 +94,22 @@ def _native():
 
 class _Leaf:
     def __init__(self, idx, name, phys, type_length, converted, scale,
-                 precision, optional, flat, is_list=False):
+                 precision, optional, flat, is_list=False,
+                 is_struct_member=False, ancestor_defs=(), max_def=0):
         self.idx, self.name, self.phys = idx, name, phys
         self.type_length, self.converted = type_length, converted
         self.scale, self.precision = scale, precision
         self.optional, self.flat = optional, flat
         self.is_list = is_list
-        # LIST leaves carry the 3-level dotted path (f.list.element); the
-        # user-facing column name is the outer field
-        self.display = name.split(".")[0] if is_list else name
+        self.is_struct_member = is_struct_member
+        self.ancestor_defs = tuple(ancestor_defs)  # per ancestor group,
+                                                   # -1 = required
+        self.max_def = max_def
+        # LIST leaves carry the 3-level dotted path (f.list.element) and
+        # STRUCT members their field path; the user-facing column name is
+        # the outer field
+        self.display = name.split(".")[0] if (is_list or is_struct_member) \
+            else name
 
     def dtype(self) -> dtypes.DType:
         if self.phys == _PT_BOOLEAN:
@@ -160,11 +173,15 @@ class ParquetChunkedReader:
             raise ValueError(self._lib.pqr_last_error().decode())
         self._leaves = self._read_schema()
         if columns is not None:
-            by_name = {l.display: l for l in self._leaves}
-            missing = [c for c in columns if c not in by_name]
+            wanted = set(columns)
+            present = {l.display for l in self._leaves}
+            missing = [c for c in columns if c not in present]
             if missing:
                 raise KeyError(f"columns not in file: {missing}")
-            self._leaves = [by_name[c] for c in columns]
+            self._leaves = [l for l in self._leaves if l.display in wanted]
+            # preserve the requested order (by first occurrence)
+            order = {c: k for k, c in enumerate(columns)}
+            self._leaves.sort(key=lambda l: order[l.display])
         self.num_row_groups = self._lib.pqr_num_row_groups(self._h)
         self.num_rows = self._lib.pqr_num_rows(self._h)
         self._next_group = 0
@@ -180,14 +197,37 @@ class ParquetChunkedReader:
             if rc != 0:
                 raise ValueError("schema read failed")
             phys, tl, conv, scale, prec, opt, flat = (x.value for x in ints)
-            is_list = self._lib.pqr_leaf_is_list(self._h, i) == 1
-            out.append(_Leaf(i, buf.value.decode(), phys, tl, conv, scale,
-                             prec, bool(opt), bool(flat), is_list))
-        return [l for l in out if l.flat or l.is_list]
+            kind = self._lib.pqr_leaf_kind(self._h, i)
+            anc, max_def = (), 0
+            if kind == 2:
+                md = ctypes.c_int32()
+                buf_anc = (ctypes.c_int32 * 16)()
+                n_anc = self._lib.pqr_leaf_struct_info(
+                    self._h, i, ctypes.byref(md), buf_anc, 16)
+                if n_anc < 0 or n_anc > 16:
+                    kind = 3            # too deep / inconsistent: skip
+                else:
+                    anc, max_def = tuple(buf_anc[:n_anc]), md.value
+            leaf = _Leaf(i, buf.value.decode(), phys, tl, conv, scale,
+                         prec, bool(opt), bool(flat), kind == 1,
+                         kind == 2, anc, max_def)
+            leaf.kind = kind
+            out.append(leaf)
+        # an unsupported leaf poisons its whole top-level field: surfacing a
+        # struct with silently missing members would misrepresent the schema
+        bad = {l.name.split(".")[0] for l in out if l.kind == 3}
+        return [l for l in out
+                if (l.flat or l.is_list or l.is_struct_member)
+                and l.display not in bad]
 
     @property
     def column_names(self) -> List[str]:
-        return [l.display for l in self._leaves]
+        names, seen = [], set()
+        for l in self._leaves:
+            if l.display not in seen:
+                seen.add(l.display)
+                names.append(l.display)
+        return names
 
     def has_next(self) -> bool:
         return self._next_group < self.num_row_groups
@@ -208,23 +248,44 @@ class ParquetChunkedReader:
         if len(chunks) == 1:
             return chunks[0]
         if not chunks:
-            return Table([self._empty_column(l) for l in self._leaves],
-                         names=self.column_names)
+            return Table(self._empty_columns(), names=self.column_names)
         return _concat_tables(chunks)
 
     def _empty_column(self, leaf: _Leaf) -> Column:
         import jax.numpy as jnp
         elem = _assemble(leaf, np.zeros(0, np.uint8), np.zeros(0, np.int32),
                          np.ones(0, np.uint8), 0, 0)
-        if not leaf.is_list:
-            return elem
-        return Column.make_list(jnp.asarray(np.zeros(1, np.int32)), elem)
+        if leaf.is_list:
+            return Column.make_list(jnp.asarray(np.zeros(1, np.int32)), elem)
+        return elem
+
+    def _empty_columns(self) -> List[Column]:
+        cols, done = [], set()
+        for leaf in self._leaves:
+            if leaf.is_struct_member:
+                if leaf.display not in done:
+                    done.add(leaf.display)
+                    members = [(l, self._empty_column(l), np.zeros(0, np.uint8))
+                               for l in self._leaves
+                               if l.is_struct_member and l.display == leaf.display]
+                    cols.append(_build_struct_tree(members, 1, 0))
+                continue
+            cols.append(self._empty_column(leaf))
+        return cols
 
     def _read_group(self, rg: int) -> Table:
         import jax.numpy as jnp  # noqa: F401  (Column builds device arrays)
         n_rows = self._lib.pqr_row_group_num_rows(self._h, rg)
         cols = []
+        done_structs = set()
         for leaf in self._leaves:
+            if leaf.is_struct_member:
+                if leaf.display not in done_structs:
+                    done_structs.add(leaf.display)
+                    members = [l for l in self._leaves
+                               if l.is_struct_member and l.display == leaf.display]
+                    cols.append(self._read_struct_chunk(rg, members, n_rows))
+                continue
             if leaf.is_list:
                 cols.append(self._read_list_chunk(rg, leaf, n_rows))
                 continue
@@ -250,6 +311,48 @@ class ParquetChunkedReader:
                                   lengths[:present.value],
                                   defined[:n_rows], n_rows, present.value))
         return Table(cols, names=self.column_names)
+
+    def _read_struct_chunk(self, rg: int, members: List[_Leaf],
+                           n_rows: int) -> Column:
+        """Assemble one STRUCT column from its member leaves: each member
+        decodes like a flat column plus its raw def levels; a struct node at
+        def threshold D is null on rows where def < D (any member's levels
+        give identical ancestor validity)."""
+        import jax.numpy as jnp
+        decoded = []
+        for leaf in members:
+            nbytes = ctypes.c_int64()
+            present = ctypes.c_int64()
+            rc = self._lib.pqr_read_column(self._h, rg, leaf.idx, None,
+                                           ctypes.byref(nbytes), None, None,
+                                           ctypes.byref(present))
+            if rc != 0:
+                raise ValueError(self._lib.pqr_last_error().decode())
+            defs = np.zeros(max(n_rows, 1), np.uint8)
+            if leaf.max_def > 0:
+                rc = self._lib.pqr_read_def_levels(
+                    self._h, rg, leaf.idx,
+                    defs.ctypes.data_as(ctypes.c_void_p))
+                if rc != 0:
+                    raise ValueError(self._lib.pqr_last_error().decode())
+            else:
+                defs[:] = leaf.max_def
+            values = np.zeros(max(nbytes.value, 1), np.uint8)
+            lengths = np.zeros(max(present.value, 1), np.int32)
+            defined = np.zeros(max(n_rows, 1), np.uint8)
+            rc = self._lib.pqr_read_column(
+                self._h, rg, leaf.idx,
+                values.ctypes.data_as(ctypes.c_void_p), ctypes.byref(nbytes),
+                lengths.ctypes.data_as(ctypes.c_void_p),
+                defined.ctypes.data_as(ctypes.c_void_p),
+                ctypes.byref(present))
+            if rc != 0:
+                raise ValueError(self._lib.pqr_last_error().decode())
+            col = _assemble(leaf, values[:nbytes.value],
+                            lengths[:present.value], defined[:n_rows],
+                            n_rows, present.value)
+            decoded.append((leaf, col, defs[:n_rows]))
+        return _build_struct_tree(decoded, level=1, n_rows=n_rows)
 
     def _read_list_chunk(self, rg: int, leaf: _Leaf, n_rows: int) -> Column:
         import jax.numpy as jnp
@@ -327,7 +430,11 @@ def _assemble(leaf: _Leaf, values: np.ndarray, lengths: np.ndarray,
 
     dt = leaf.dtype()
     validity = None
-    if leaf.optional and (defined == 0).any():
+    # struct members: a required member under an optional ancestor still has
+    # undefined rows (the ancestor was null) — its child column must carry
+    # that validity so direct child consumers see nulls, like cudf
+    nullable = leaf.optional or getattr(leaf, "is_struct_member", False)
+    if nullable and (defined == 0).any():
         validity = jnp.asarray(defined != 0)
 
     if dt.kind == dtypes.Kind.STRING:
@@ -373,6 +480,40 @@ def _assemble(leaf: _Leaf, values: np.ndarray, lengths: np.ndarray,
         full = full != 0
     return Column(dtype=dt, length=n_rows, data=jnp.asarray(full),
                   validity=validity)
+
+
+def _build_struct_tree(decoded, level: int, n_rows: int) -> Column:
+    """decoded: [(leaf, element Column, def_levels)]; group by the path
+    segment at `level` (level 0 is the struct column itself's name)."""
+    import jax.numpy as jnp
+
+    first_leaf, _, first_defs = decoded[0]
+    segs = first_leaf.name.split(".")
+    # validity of THIS node (ancestor index level-1): -1 = required group
+    thresh = first_leaf.ancestor_defs[level - 1]
+    validity = None
+    if thresh >= 0 and (first_defs < thresh).any():
+        validity = jnp.asarray(first_defs >= thresh)
+
+    fields = {}
+    for leaf, col, defs in decoded:
+        parts = leaf.name.split(".")
+        key = parts[level]
+        if len(parts) == level + 1:
+            fields[key] = col              # direct member
+        else:                              # deeper nesting: recurse per key
+            fields.setdefault(key, []).append((leaf, col, defs))
+    out_fields = {}
+    for key, val in fields.items():
+        if isinstance(val, list):
+            out_fields[key] = _build_struct_tree(val, level + 1, n_rows)
+        else:
+            out_fields[key] = val
+    dt = dtypes.DType(dtypes.Kind.STRUCT,
+                      children=tuple(c.dtype for c in out_fields.values()),
+                      field_names=tuple(out_fields.keys()))
+    return Column(dtype=dt, length=n_rows, validity=validity,
+                  children=tuple(out_fields.values()))
 
 
 def _concat_tables(tables: List[Table]) -> Table:

@@ -9,8 +9,10 @@
 // decode hot path lives here as native host code and hands the TPU dense
 // Arrow-layout buffers (values + validity + offsets) ready for device_put.
 //
-// Scope: flat schemas + standard 3-level LIST<primitive> (Spark array
-// columns; MAP/LIST<STRUCT>/STRUCT shapes are skipped, never mis-surfaced);
+// Scope: flat schemas, standard 3-level LIST<primitive> (Spark array
+// columns) and STRUCT<primitive> at any nesting depth (validity rebuilt
+// from raw def levels); MAP / LIST<STRUCT> / structs with unsupported
+// members are skipped whole, never mis-surfaced;
 // PLAIN / RLE / PLAIN_DICTIONARY /
 // RLE_DICTIONARY / DELTA_BINARY_PACKED / DELTA_LENGTH_BYTE_ARRAY /
 // DELTA_BYTE_ARRAY / BYTE_STREAM_SPLIT encodings; DataPage v1+v2;
@@ -159,6 +161,12 @@ struct LeafSchema {
   int32_t def_at_repeated = 0;  // cumulative def at the repeated node (lists)
   bool is_list = false;         // standard LIST shape: exactly one repeated
                                 // ancestor over a primitive leaf
+  // non-repeated leaf nested under plain (non-LIST/MAP, non-repeated)
+  // groups — a STRUCT member; ancestor_defs[i] is the cumulative def level
+  // at the i-th ancestor group (outermost first), or -1 if that group is
+  // required (always valid)
+  bool is_struct_member = false;
+  std::vector<int32_t> ancestor_defs;
 };
 
 struct ChunkMeta {
@@ -236,8 +244,12 @@ void parse_schema(TReader& r, std::vector<LeafSchema>& leaves) {
     std::string path;
     int32_t elem_idx;          // index into elems (-1 for root)
     int depth;
+    bool plain_chain;          // every ancestor is a non-repeated,
+                               // non-annotated group (STRUCT nesting)
+    std::vector<int32_t> opt_ancestor_defs;
   };
-  std::vector<Frame> stack{{elems[0].num_children, 0, 0, -1, "", 0, 0}};
+  std::vector<Frame> stack{{elems[0].num_children, 0, 0, -1, "", 0, 0,
+                            true, {}}};
   while (pos < elems.size() && !stack.empty()) {
     while (!stack.empty() && stack.back().remaining == 0) stack.pop_back();
     if (stack.empty()) break;
@@ -252,8 +264,15 @@ void parse_schema(TReader& r, std::vector<LeafSchema>& leaves) {
     std::string path =
         top.path.empty() ? e.leaf.name : top.path + "." + e.leaf.name;
     if (e.is_group) {
+      bool plain = top.plain_chain && e.repetition != 2 &&
+                   e.leaf.converted != 1 && e.leaf.converted != 2 &&
+                   e.leaf.converted != 3;   // not MAP/MAP_KEY_VALUE/LIST
+      auto anc = top.opt_ancestor_defs;
+      // one entry per ancestor group: its def level if optional, -1 if
+      // required (always-valid) — index-aligned with the path segments
+      anc.push_back(e.repetition == 1 ? def : -1);
       stack.push_back({e.num_children, def, rep, dar, path,
-                       int32_t(cur_idx), depth});
+                       int32_t(cur_idx), depth, plain, std::move(anc)});
     } else {
       LeafSchema leaf = e.leaf;
       leaf.name = path;
@@ -268,6 +287,9 @@ void parse_schema(TReader& r, std::vector<LeafSchema>& leaves) {
       // (ConvertedType LIST == 3); MAP key_value groups (2 children) and
       // LIST<STRUCT> (parent is a struct group) fail these tests
       leaf.is_list = false;
+      leaf.is_struct_member =
+          depth > 1 && rep == 0 && e.repetition != 2 && top.plain_chain;
+      if (leaf.is_struct_member) leaf.ancestor_defs = top.opt_ancestor_defs;
       if (rep == 1 && e.repetition != 2 && stack.size() >= 3) {
         Frame const& parent = stack[stack.size() - 1];
         Frame const& grand = stack[stack.size() - 2];
@@ -626,6 +648,8 @@ struct DecodedChunk {
   // list chunks only (leaf.is_list):
   std::vector<int32_t> list_counts;  // element slots per row
   std::vector<uint8_t> list_valid;   // per-row list validity
+  // struct members only: raw definition level per row (<= max_def <= 255)
+  std::vector<uint8_t> def_levels;
 };
 
 inline int level_bit_width(int32_t max_level) {
@@ -943,9 +967,13 @@ DecodedChunk decode_chunk(FileState const& st, ChunkMeta const& cm,
       }
     } else if (!defs.empty()) {
       present = 0;
+      // any optional ancestor or member needs the raw levels (max_def==1
+      // covers an optional struct whose members are all required)
+      bool const keep_levels = leaf.is_struct_member && leaf.max_def > 0;
       for (int64_t i = 0; i < page_values; i++) {
         bool d = defs[i] == leaf.max_def;
         out.defined.push_back(uint8_t(d));
+        if (keep_levels) out.def_levels.push_back(uint8_t(defs[i]));
         if (d) present++;
       }
     } else {
@@ -1081,10 +1109,48 @@ std::shared_ptr<DecodedChunk> get_chunk(FileState* st, int32_t rg,
   return dcp;
 }
 
-int32_t pqr_leaf_is_list(void* h, int32_t i) {
+// 0 = flat primitive, 1 = LIST<primitive>, 2 = STRUCT member (primitive
+// under plain groups), 3 = unsupported shape
+int32_t pqr_leaf_kind(void* h, int32_t i) {
   auto* st = static_cast<FileState*>(h);
   if (i < 0 || size_t(i) >= st->leaves.size()) return -1;
-  return st->leaves[i].is_list ? 1 : 0;
+  auto const& l = st->leaves[i];
+  if (l.flat) return 0;
+  if (l.is_list) return 1;
+  if (l.is_struct_member) return 2;
+  return 3;
+}
+
+// ancestor def levels for a struct-member leaf, one per ancestor group
+// outermost first (-1 = required group); returns the count, or -1 on error.
+int32_t pqr_leaf_struct_info(void* h, int32_t i, int32_t* max_def,
+                             int32_t* anc_defs, int32_t anc_cap) {
+  auto* st = static_cast<FileState*>(h);
+  if (i < 0 || size_t(i) >= st->leaves.size()) return -1;
+  auto const& l = st->leaves[i];
+  if (!l.is_struct_member) return -1;
+  *max_def = l.max_def;
+  int32_t n = int32_t(l.ancestor_defs.size());
+  for (int32_t k = 0; k < n && k < anc_cap; k++) anc_defs[k] = l.ancestor_defs[k];
+  return n;
+}
+
+// raw def levels of a sized-but-not-yet-consumed chunk (call between the
+// sizing and fill calls of pqr_read_column); one byte per row
+int32_t pqr_read_def_levels(void* h, int32_t rg, int32_t leaf, uint8_t* out) {
+  auto* st = static_cast<FileState*>(h);
+  try {
+    if (leaf < 0 || size_t(leaf) >= st->leaves.size())
+      throw std::runtime_error("leaf out of range");
+    auto dcp = get_chunk(st, rg, leaf, false);
+    if (dcp->def_levels.empty())
+      throw std::runtime_error("no def levels for this chunk");
+    std::memcpy(out, dcp->def_levels.data(), dcp->def_levels.size());
+    return 0;
+  } catch (std::exception const& e) {
+    g_error = e.what();
+    return -1;
+  }
 }
 
 // Two-phase read of a LIST<primitive> column chunk (standard 3-level shape).
@@ -1163,7 +1229,7 @@ int32_t pqr_read_column(void* h, int32_t rg, int32_t leaf,
     if (leaf < 0 || size_t(leaf) >= st->leaves.size())
       throw std::runtime_error("leaf out of range");
     auto const& lf = st->leaves[leaf];
-    if (!lf.flat)
+    if (!lf.flat && !lf.is_struct_member)
       throw std::runtime_error(
           lf.is_list ? "list column: use pqr_read_list_column"
                      : "nested/repeated columns unsupported");

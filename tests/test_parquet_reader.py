@@ -305,3 +305,82 @@ def test_map_and_nested_struct_shapes_excluded_not_corrupted(tmp_path):
     assert list(got.names) == ["ok", "larr"]
     assert got["ok"].to_pylist() == [10, 20]
     assert got["larr"].to_pylist() == [[1, 2], [3]]
+
+
+class TestStructColumns:
+    """STRUCT<primitive> members decode flat + raw def levels; ancestor
+    validity is rebuilt from the def threshold at each optional group."""
+
+    ROWS = [{"x": 1, "y": "a"}, None, {"x": None, "y": "c"},
+            {"x": 4, "y": None}] * 300
+    DEEP = [{"inner": {"p": 1.5}, "q": 7}, {"inner": None, "q": 8}, None,
+            {"inner": {"p": None}, "q": None}] * 300
+
+    def _table(self):
+        return pa.table({
+            "s": pa.array(self.ROWS, pa.struct([("x", pa.int64()),
+                                                ("y", pa.utf8())])),
+            "d": pa.array(self.DEEP,
+                          pa.struct([("inner",
+                                      pa.struct([("p", pa.float64())])),
+                                     ("q", pa.int32())])),
+            "flat": pa.array(range(len(self.ROWS))),
+        })
+
+    @pytest.mark.parametrize("kw", [
+        dict(),
+        dict(data_page_version="2.0", compression="ZSTD"),
+    ])
+    def test_round_trip_multi_row_group(self, tmp_path, kw):
+        path = str(tmp_path / "structs.parquet")
+        pq.write_table(self._table(), path, row_group_size=500, **kw)
+        got = read_parquet(path)
+        assert list(got.names) == ["s", "d", "flat"]
+        assert got["s"].to_pylist() == self.ROWS
+        assert got["d"].to_pylist() == self.DEEP
+        assert got["flat"].to_pylist() == list(range(len(self.ROWS)))
+
+    def test_column_selection(self, tmp_path):
+        path = str(tmp_path / "sel.parquet")
+        pq.write_table(self._table(), path)
+        got = read_parquet(path, columns=["d", "flat"])
+        assert list(got.names) == ["d", "flat"]
+        assert got["d"].to_pylist() == self.DEEP
+
+    def test_required_struct_fields(self, tmp_path):
+        t = pa.table({"s": pa.array(
+            [{"a": 1}, {"a": 2}],
+            pa.struct([pa.field("a", pa.int64(), nullable=False)]))})
+        path = str(tmp_path / "req.parquet")
+        pq.write_table(t, path)
+        got = read_parquet(path)
+        assert got["s"].to_pylist() == [{"a": 1}, {"a": 2}]
+
+
+def test_optional_struct_all_required_members(tmp_path):
+    """max_def==1: an optional struct whose members are all required — the
+    null struct row must not surface as a fabricated zero row."""
+    t = pa.table({"s": pa.array(
+        [{"a": 1}, None, {"a": 3}],
+        pa.struct([pa.field("a", pa.int64(), nullable=False)]))})
+    path = str(tmp_path / "opt_req.parquet")
+    pq.write_table(t, path)
+    got = read_parquet(path)
+    assert got["s"].to_pylist() == [{"a": 1}, None, {"a": 3}]
+    # the child column itself must carry the ancestor-null rows as nulls
+    assert got["s"].children[0].to_pylist() == [1, None, 3]
+
+
+def test_struct_with_unsupported_member_dropped_whole(tmp_path):
+    """struct<x:int64, v:list<int64>>: surfacing it without v would
+    misrepresent the schema — drop the whole field."""
+    t = pa.table({
+        "s": pa.array([{"x": 1, "v": [1, 2]}],
+                      pa.struct([("x", pa.int64()),
+                                 ("v", pa.list_(pa.int64()))])),
+        "ok": pa.array([5]),
+    })
+    path = str(tmp_path / "partial.parquet")
+    pq.write_table(t, path)
+    got = read_parquet(path)
+    assert list(got.names) == ["ok"]
