@@ -18,6 +18,18 @@ t = pa.table({
 })
 pq.write_table(t, "$OUT/smoke.parquet", row_group_size=256,
                compression="SNAPPY")
+t2 = pa.table({
+    "li": pa.array([[1, 2], None, []] * 100, pa.list_(pa.int64())),
+    "st": pa.array([{"a": 1, "b": "x"}, None] * 150,
+                   pa.struct([("a", pa.int64()), ("b", pa.string())])),
+    "dl": pa.array(list(range(300))),
+})
+pq.write_table(t2, "$OUT/nested.parquet", row_group_size=128,
+               use_dictionary=False, data_page_version="2.0",
+               column_encoding={"li.list.element": "DELTA_BINARY_PACKED",
+                                "st.a": "DELTA_BINARY_PACKED",
+                                "st.b": "DELTA_BYTE_ARRAY",
+                                "dl": "DELTA_BINARY_PACKED"})
 EOF
 
 g++ -std=c++17 -O1 -g -pthread -fsanitize=address,undefined \
@@ -30,5 +42,5 @@ g++ -std=c++17 -O1 -g -pthread -fsanitize=address,undefined \
     -lz -lzstd -l:libsnappy.so.1
 
 ASAN_OPTIONS="abort_on_error=1" UBSAN_OPTIONS="halt_on_error=1" \
-    "$OUT/native_smoke" "$OUT/smoke.parquet"
+    "$OUT/native_smoke" "$OUT/smoke.parquet" "$OUT/nested.parquet"
 echo "sanitizer OK"

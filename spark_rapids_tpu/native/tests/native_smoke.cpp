@@ -53,6 +53,15 @@ char const* pqr_last_error();
 int64_t pqr_num_rows(void* h);
 int32_t pqr_num_row_groups(void* h);
 int32_t pqr_num_leaves(void* h);
+int32_t pqr_leaf_kind(void* h, int32_t i);
+int64_t pqr_row_group_num_rows(void* h, int32_t rg);
+int32_t pqr_read_list_column(void* h, int32_t rg, int32_t leaf,
+                             uint8_t* values, int64_t* values_nbytes,
+                             int32_t* lengths, uint8_t* elem_defined,
+                             int64_t* num_elem_slots, int64_t* num_present,
+                             int32_t* row_counts, uint8_t* row_valid,
+                             int64_t* num_rows);
+int32_t pqr_read_def_levels(void* h, int32_t rg, int32_t leaf, uint8_t* out);
 int32_t pqr_read_column(void* h, int32_t rg, int32_t leaf, uint8_t* values,
                         int64_t* values_nbytes, int32_t* lengths,
                         uint8_t* defined, int64_t* num_present);
@@ -218,11 +227,69 @@ static void test_parquet(char const* path) {
   }
 }
 
+// nested file: list + struct + delta-encoded columns (written by the
+// sanitizer driver) — exercises level decode, Dremel reassembly and the
+// delta decoders under ASan
+static void test_parquet_nested(char const* path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) {
+    std::fprintf(stderr, "SKIP nested parquet test: cannot open %s\n", path);
+    return;
+  }
+  std::vector<uint8_t> bytes((std::istreambuf_iterator<char>(f)),
+                             std::istreambuf_iterator<char>());
+  void* h = pqr_open_ex(bytes.data(), int64_t(bytes.size()), 0);
+  CHECK(h != nullptr);
+  if (!h) { std::fprintf(stderr, "%s\n", pqr_last_error()); return; }
+  bool saw_list = false, saw_struct = false;
+  for (int32_t leaf = 0; leaf < pqr_num_leaves(h); leaf++) {
+    int32_t kind = pqr_leaf_kind(h, leaf);
+    for (int32_t rg = 0; rg < pqr_num_row_groups(h); rg++) {
+      size_t const rg_rows = size_t(pqr_row_group_num_rows(h, rg));
+      if (kind == 1) {
+        saw_list = true;
+        int64_t nbytes = 0, slots = 0, present = 0, rows = 0;
+        CHECK(pqr_read_list_column(h, rg, leaf, nullptr, &nbytes, nullptr,
+                                   nullptr, &slots, &present, nullptr,
+                                   nullptr, &rows) == 0);
+        std::vector<uint8_t> values(size_t(nbytes) + 1);
+        std::vector<int32_t> lengths(size_t(present) + 1);
+        std::vector<uint8_t> edef(size_t(slots) + 1);
+        std::vector<int32_t> counts(size_t(rows) + 1);
+        std::vector<uint8_t> valid(size_t(rows) + 1);
+        CHECK(pqr_read_list_column(h, rg, leaf, values.data(), &nbytes,
+                                   lengths.data(), edef.data(), &slots,
+                                   &present, counts.data(), valid.data(),
+                                   &rows) == 0);
+        int64_t total = 0;
+        for (int64_t i = 0; i < rows; i++) total += counts[size_t(i)];
+        CHECK(total == slots);
+      } else if (kind == 0 || kind == 2) {
+        if (kind == 2) saw_struct = true;
+        int64_t nbytes = 0, present = 0;
+        CHECK(pqr_read_column(h, rg, leaf, nullptr, &nbytes, nullptr,
+                              nullptr, &present) == 0);
+        std::vector<uint8_t> defs(rg_rows + 1);
+        if (kind == 2)
+          CHECK(pqr_read_def_levels(h, rg, leaf, defs.data()) == 0);
+        std::vector<uint8_t> values(size_t(nbytes) + 1);
+        std::vector<int32_t> lengths(size_t(present) + 1);
+        std::vector<uint8_t> defined(rg_rows + 1);
+        CHECK(pqr_read_column(h, rg, leaf, values.data(), &nbytes,
+                              lengths.data(), defined.data(), &present) == 0);
+      }
+    }
+  }
+  CHECK(saw_list && saw_struct);
+  pqr_free(h);
+}
+
 int main(int argc, char** argv) {
   test_alloc_retry_block_wake();
   test_deadlock_escalates_to_retry_oom();
   test_injection();
   if (argc > 1) test_parquet(argv[1]);
+  if (argc > 2) test_parquet_nested(argv[2]);
   if (g_failures) {
     std::fprintf(stderr, "%d native test failures\n", g_failures);
     return 1;

@@ -262,21 +262,50 @@ def distributed_sort(mesh: Mesh, keys: jnp.ndarray, vals: jnp.ndarray,
     return fn(keys, vals)
 
 
-def _local_join_tail(lk, lv, lalive, rk, rv, ralive, row_cap: int):
-    """Shard-local inner join into a fixed row_cap: union rank + sort-merge
-    spans + padded expansion (ops/join.py machinery on shard-local shapes).
-    Returns (lkey, lval, rval, live, overflow-scalar)."""
+def _local_join_tail(lk, lv, lalive, rk, rv, ralive, row_cap: int,
+                     outer: bool = False):
+    """Shard-local (inner or left-outer) join into a fixed row_cap: union
+    rank + sort-merge spans + padded expansion (ops/join.py machinery on
+    shard-local shapes). Returns (lkey, lval, rval, rmatched, live,
+    overflow-scalar); rmatched is False on left-outer rows with no match
+    (their rval slot is 0 and must be read as null)."""
     from ..ops.join import _expand, _match_spans, _union_ranks
     nl = lk.shape[0]
+    if outer:
+        # dead (padded) rows also get an output slot under outer expansion's
+        # eff=max(counts,1): push them to the END so live slots form a
+        # prefix that a single `< total_live` mask selects
+        order = jnp.argsort(~lalive, stable=True)
+        lk = jnp.take(lk, order, axis=0)
+        lv = jnp.take(lv, order, axis=0)
+        lalive = jnp.take(lalive, order, axis=0)
     ranks = _union_ranks((jnp.concatenate([lk, rk]),), n_ops=1)
     counts, lo, rorder = _match_spans(ranks[:nl], lalive, ranks[nl:], ralive)
-    lsel, rsel = _expand(counts, lo, rorder, total=row_cap, outer=False)
-    total = jnp.sum(counts)
+    lsel, rsel = _expand(counts, lo, rorder, total=row_cap, outer=outer)
+    if outer:
+        total = jnp.sum(jnp.where(lalive, jnp.maximum(counts, 1), 0))
+    else:
+        total = jnp.sum(counts)
     live = jnp.arange(row_cap, dtype=jnp.int32) < total
+    rmatched = rsel >= 0 if outer else jnp.ones((row_cap,), bool)
     out_lk = jnp.where(live, jnp.take(lk, lsel, axis=0), 0)
     out_lv = jnp.where(live, jnp.take(lv, lsel, axis=0), 0)
-    out_rv = jnp.where(live, jnp.take(rv, rsel, axis=0), 0)
-    return out_lk, out_lv, out_rv, live, total > row_cap
+    safe_rsel = jnp.maximum(rsel, 0)
+    out_rv = jnp.where(live & rmatched, jnp.take(rv, safe_rsel, axis=0), 0)
+    return out_lk, out_lv, out_rv, rmatched & live, live, total > row_cap
+
+
+def _hash_exchange(axis: str, n_peers: int, slack: float,
+                   keys: jnp.ndarray, vals):
+    """Hash-partition by Spark murmur pmod and all-to-all one table side
+    (the shared shuffle wiring of every distributed join). `vals` may be
+    None (key-only sides, e.g. semi/anti build side)."""
+    nloc = keys.shape[0]
+    cap = max(1, math.ceil(nloc / n_peers * slack))
+    part = partition_ids(_spark_murmur_i64(keys), n_peers)
+    payloads = [(keys, _DEAD_KEY)] + ([(vals, 0)] if vals is not None else [])
+    outs, alive, spilled = _bucket_exchange(axis, n_peers, cap, part, payloads)
+    return outs, alive, spilled
 
 
 def distributed_inner_join(mesh: Mesh, lkeys: jnp.ndarray, lvals: jnp.ndarray,
@@ -305,7 +334,7 @@ def distributed_inner_join(mesh: Mesh, lkeys: jnp.ndarray, lvals: jnp.ndarray,
         Lk, Lv, Lalive, lspill = reshuffle(lk, lv)
         Rk, Rv, Ralive, rspill = reshuffle(rk, rv)
 
-        out_lk, out_lv, out_rv, live, joverflow = _local_join_tail(
+        out_lk, out_lv, out_rv, _, live, joverflow = _local_join_tail(
             Lk, Lv, Lalive, Rk, Rv, Ralive, row_cap)
         overflow = joverflow | lspill | rspill
         return out_lk, out_lv, out_rv, live, overflow.reshape(1)
@@ -337,7 +366,7 @@ def distributed_broadcast_join(mesh: Mesh, lkeys: jnp.ndarray,
         Rv = jax.lax.all_gather(rv, axis, tiled=True)
         all_l = jnp.ones((lk.shape[0],), jnp.bool_)
         all_r = jnp.ones((Rk.shape[0],), jnp.bool_)
-        out_lk, out_lv, out_rv, live, overflow = _local_join_tail(
+        out_lk, out_lv, out_rv, _, live, overflow = _local_join_tail(
             lk, lv, all_l, Rk, Rv, all_r, row_cap)
         return out_lk, out_lv, out_rv, live, overflow.reshape(1)
 
@@ -345,3 +374,68 @@ def distributed_broadcast_join(mesh: Mesh, lkeys: jnp.ndarray,
     fn = shard_map(local, mesh=mesh, in_specs=(spec,) * 4,
                    out_specs=(spec,) * 5)
     return fn(lkeys, lvals, rkeys, rvals)
+
+
+def distributed_left_join(mesh: Mesh, lkeys: jnp.ndarray, lvals: jnp.ndarray,
+                          rkeys: jnp.ndarray, rvals: jnp.ndarray,
+                          row_cap: int, slack: float = 2.0,
+                          axis: str = "data"):
+    """Left-outer equi-join, same shuffle as distributed_inner_join.
+
+    Returns per-shard padded (lkey, lval, rval, rvalid, valid, overflow):
+    rvalid is False on unmatched left rows (their rval slot must be read as
+    null)."""
+    n_peers = mesh.shape[axis]
+
+    def local(lk, lv, rk, rv):
+        (Lk, Lv), Lalive, lspill = _hash_exchange(axis, n_peers, slack, lk, lv)
+        (Rk, Rv), Ralive, rspill = _hash_exchange(axis, n_peers, slack, rk, rv)
+        out_lk, out_lv, out_rv, rvalid, live, joverflow = _local_join_tail(
+            Lk, Lv, Lalive, Rk, Rv, Ralive, row_cap, outer=True)
+        overflow = joverflow | lspill | rspill
+        return out_lk, out_lv, out_rv, rvalid, live, overflow.reshape(1)
+
+    spec = P(axis)
+    fn = shard_map(local, mesh=mesh, in_specs=(spec,) * 4,
+                   out_specs=(spec,) * 6)
+    return fn(lkeys, lvals, rkeys, rvals)
+
+
+def _distributed_semi_anti(mesh, lkeys, lvals, rkeys, semi, slack, axis):
+    """Shared body: mark each left row matched/unmatched after the exchange;
+    output stays left-shaped (no expansion, no row_cap)."""
+    from ..ops.join import _match_spans, _union_ranks
+    n_peers = mesh.shape[axis]
+
+    def local(lk, lv, rk):
+        (Lk, Lv), Lalive, lspill = _hash_exchange(axis, n_peers, slack, lk, lv)
+        (Rk,), Ralive, rspill = _hash_exchange(axis, n_peers, slack, rk, None)
+        nl = Lk.shape[0]
+        ranks = _union_ranks((jnp.concatenate([Lk, Rk]),), n_ops=1)
+        counts, _, _ = _match_spans(ranks[:nl], Lalive, ranks[nl:], Ralive)
+        hit = counts > 0
+        keep = Lalive & (hit if semi else ~hit)
+        out_lk = jnp.where(keep, Lk, 0)
+        out_lv = jnp.where(keep, Lv, 0)
+        overflow = lspill | rspill
+        return out_lk, out_lv, keep, overflow.reshape(1)
+
+    spec = P(axis)
+    fn = shard_map(local, mesh=mesh, in_specs=(spec,) * 3,
+                   out_specs=(spec,) * 4)
+    return fn(lkeys, lvals, rkeys)
+
+
+def distributed_left_semi_join(mesh: Mesh, lkeys: jnp.ndarray,
+                               lvals: jnp.ndarray, rkeys: jnp.ndarray,
+                               slack: float = 2.0, axis: str = "data"):
+    """Left rows with at least one match. Returns per-shard padded
+    (lkey, lval, valid, overflow); output is left-sized, no row_cap."""
+    return _distributed_semi_anti(mesh, lkeys, lvals, rkeys, True, slack, axis)
+
+
+def distributed_left_anti_join(mesh: Mesh, lkeys: jnp.ndarray,
+                               lvals: jnp.ndarray, rkeys: jnp.ndarray,
+                               slack: float = 2.0, axis: str = "data"):
+    """Left rows with no match. Same contract as the semi join."""
+    return _distributed_semi_anti(mesh, lkeys, lvals, rkeys, False, slack, axis)

@@ -192,3 +192,57 @@ def test_broadcast_join_overflow_flag():
     args = [jax.device_put(jnp.asarray(x), sh) for x in (lk, lv, rk, rv)]
     *_, overflow = distributed_broadcast_join(mesh, *args, row_cap=4)
     assert bool(jnp.any(overflow))        # 8*NDEV matches per shard >> 4
+
+
+def test_distributed_left_join_matches_local():
+    from spark_rapids_tpu.parallel import distributed_left_join
+    mesh = _mesh()
+    rng = np.random.default_rng(31)
+    nl, nr = NDEV * 32, NDEV * 8
+    lk = rng.integers(0, 40, nl).astype(np.int64)
+    lv = rng.integers(-100, 100, nl).astype(np.int64)
+    rk = rng.permutation(64)[:nr].astype(np.int64)
+    rv = rng.integers(-100, 100, nr).astype(np.int64)
+    sh = NamedSharding(mesh, P("data"))
+    args = [jax.device_put(jnp.asarray(x), sh) for x in (lk, lv, rk, rv)]
+    out_lk, out_lv, out_rv, rvalid, valid, overflow = distributed_left_join(
+        mesh, *args, row_cap=nl * 4 // NDEV, slack=5.0)
+    assert not bool(jnp.any(overflow))
+    v = np.asarray(valid)
+    got = sorted(zip(np.asarray(out_lk)[v].tolist(),
+                     np.asarray(out_lv)[v].tolist(),
+                     [w if m else None for w, m in
+                      zip(np.asarray(out_rv)[v].tolist(),
+                          np.asarray(rvalid)[v].tolist())]))
+    rmap = {int(k): int(w) for k, w in zip(rk, rv)}
+    want = sorted((int(k), int(w), rmap.get(int(k)))
+                  for k, w in zip(lk, lv))
+    assert got == want
+
+
+def test_distributed_semi_anti_join():
+    from spark_rapids_tpu.parallel import (distributed_left_anti_join,
+                                           distributed_left_semi_join)
+    mesh = _mesh()
+    rng = np.random.default_rng(33)
+    nl, nr = NDEV * 24, NDEV * 4
+    lk = rng.integers(0, 50, nl).astype(np.int64)
+    lv = np.arange(nl, dtype=np.int64)
+    rk = rng.permutation(50)[:nr].astype(np.int64)
+    sh = NamedSharding(mesh, P("data"))
+    largs = [jax.device_put(jnp.asarray(x), sh) for x in (lk, lv, rk)]
+    rset = set(rk.tolist())
+
+    sk, sv, svalid, soverflow = distributed_left_semi_join(mesh, *largs,
+                                                           slack=5.0)
+    assert not bool(jnp.any(soverflow))
+    got = sorted(np.asarray(sv)[np.asarray(svalid)].tolist())
+    want = sorted(int(v) for k, v in zip(lk, lv) if int(k) in rset)
+    assert got == want
+
+    ak, av, avalid, aoverflow = distributed_left_anti_join(mesh, *largs,
+                                                           slack=5.0)
+    assert not bool(jnp.any(aoverflow))
+    got = sorted(np.asarray(av)[np.asarray(avalid)].tolist())
+    want = sorted(int(v) for k, v in zip(lk, lv) if int(k) not in rset)
+    assert got == want
