@@ -305,6 +305,10 @@ def _hash_exchange(axis: str, n_peers: int, slack: float,
     part = partition_ids(_spark_murmur_i64(keys), n_peers)
     payloads = [(keys, _DEAD_KEY)] + ([(vals, 0)] if vals is not None else [])
     outs, alive, spilled = _bucket_exchange(axis, n_peers, cap, part, payloads)
+    # a spill anywhere means some shard RECEIVED an incomplete side: agree on
+    # the flag across the mesh (same contract as distributed_sort) so the
+    # shard whose output is wrong also reports overflow
+    spilled = jax.lax.all_gather(spilled.reshape(1), axis).any()
     return outs, alive, spilled
 
 
@@ -323,16 +327,8 @@ def distributed_inner_join(mesh: Mesh, lkeys: jnp.ndarray, lvals: jnp.ndarray,
     n_peers = mesh.shape[axis]
 
     def local(lk, lv, rk, rv):
-        def reshuffle(keys, vals):
-            nloc = keys.shape[0]
-            cap = max(1, math.ceil(nloc / n_peers * slack))
-            part = partition_ids(_spark_murmur_i64(keys), n_peers)
-            (rk_, rv_), ralive, spilled = _bucket_exchange(
-                axis, n_peers, cap, part, [(keys, _DEAD_KEY), (vals, 0)])
-            return rk_, rv_, ralive, spilled
-
-        Lk, Lv, Lalive, lspill = reshuffle(lk, lv)
-        Rk, Rv, Ralive, rspill = reshuffle(rk, rv)
+        (Lk, Lv), Lalive, lspill = _hash_exchange(axis, n_peers, slack, lk, lv)
+        (Rk, Rv), Ralive, rspill = _hash_exchange(axis, n_peers, slack, rk, rv)
 
         out_lk, out_lv, out_rv, _, live, joverflow = _local_join_tail(
             Lk, Lv, Lalive, Rk, Rv, Ralive, row_cap)
