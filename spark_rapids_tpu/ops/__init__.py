@@ -29,7 +29,7 @@ from .histogram import create_histogram_if_valid, percentile_from_histogram
 from .map_utils import from_json
 from .gather import take, take_table, apply_boolean_mask
 from .sort import sorted_order, sort_table
-from .aggregate import groupby_aggregate
+from .aggregate import groupby_aggregate, groupby_aggregate_capped
 from .join import inner_join, left_join, left_semi_join, left_anti_join
 from .copying import (concat_columns, concat_tables, slice_table,
                       split_table, halve_table, replace_nulls, if_else,
@@ -57,7 +57,7 @@ __all__ = [
     "create_histogram_if_valid", "percentile_from_histogram",
     "from_json",
     "take", "take_table", "apply_boolean_mask", "sorted_order", "sort_table",
-    "groupby_aggregate",
+    "groupby_aggregate", "groupby_aggregate_capped",
     "inner_join", "left_join", "left_semi_join", "left_anti_join",
     "concat_columns", "concat_tables", "slice_table", "split_table",
     "halve_table", "replace_nulls", "if_else", "drop_duplicates",

@@ -172,10 +172,14 @@ def _groupby_kernel(key_operands, agg_datas, agg_valids, *, n_ops: int,
 
 def groupby_aggregate(table: Table,
                       key_names: Sequence[Union[int, str]],
-                      aggs: Sequence[Tuple[Union[int, str], str]]) -> Table:
+                      aggs: Sequence[Tuple[Union[int, str], str]],
+                      _cap: Optional[int] = None):
     """Group by `key_names`, apply `aggs` [(column, op)] with op in
     sum|count|min|max|mean|size. Returns keys + one column per agg, named
-    "op(col)". Group order = key sort order (deterministic)."""
+    "op(col)". Group order = key sort order (deterministic).
+
+    `_cap` is internal (see groupby_aggregate_capped): a static output size
+    that makes the whole aggregation traceable under jax.jit."""
     keys = [table[k] for k in key_names]
     if not keys:
         raise ValueError("groupby requires at least one key column")
@@ -224,7 +228,16 @@ def groupby_aggregate(table: Table,
     num_groups, first_sorted, order, outs = _groupby_kernel(
         tuple(operands), tuple(agg_datas), tuple(agg_valids),
         n_ops=len(operands), agg_kinds=tuple(agg_kinds))
-    g = int(num_groups)  # the one host sync
+    if _cap is None:
+        g = int(num_groups)  # the one host sync
+    else:
+        # slice what exists, pad the rest below (a fixed-cap jit pipeline
+        # must accept small batches, and a too-small cap must be retryable
+        # with a bigger one regardless of n)
+        g = min(_cap, n)
+    # padded first_sorted entries hold n: clip for the gather — rows past
+    # num_groups are garbage by contract, masked by the capped valid vector
+    first_sorted = jnp.clip(first_sorted, 0, max(n - 1, 0))
 
     # key columns: row index (original frame) of each group's first sorted row
     first_rows = jnp.take(order, first_sorted[:g], axis=0)
@@ -286,4 +299,47 @@ def groupby_aggregate(table: Table,
                                data=d.astype(dt.storage_dtype()), validity=v))
         names.append(f"{op}({cname})")
 
-    return Table(out_cols, names)
+    if _cap is None:
+        return Table(out_cols, names)
+    out_cols = [_pad_column(c, _cap) for c in out_cols]
+    valid = jnp.arange(_cap, dtype=jnp.int32) < num_groups
+    return Table(out_cols, names), valid, num_groups > _cap
+
+
+def _pad_column(col: Column, to: int) -> Column:
+    """Pad a column to `to` rows with masked garbage (capped-output
+    contract: rows past the real group count are selected away by the
+    caller's valid vector)."""
+    n = col.length
+    if n >= to:
+        return col
+    extra = to - n
+    validity = None
+    if col.validity is not None:
+        validity = jnp.concatenate([col.null_mask,
+                                    jnp.zeros((extra,), bool)])
+    if col.dtype.is_string:
+        last = col.offsets[-1] if n else jnp.int32(0)
+        offsets = jnp.concatenate(
+            [col.offsets, jnp.full((extra,), last, jnp.int32)])
+        return Column(dtype=col.dtype, length=to, data=col.data,
+                      offsets=offsets, validity=validity)
+    data = jnp.concatenate(
+        [col.data, jnp.zeros((extra,) + col.data.shape[1:], col.data.dtype)])
+    return Column(dtype=col.dtype, length=to, data=data, validity=validity)
+
+
+def groupby_aggregate_capped(table: Table,
+                             key_names: Sequence[Union[int, str]],
+                             aggs: Sequence[Tuple[Union[int, str], str]],
+                             key_cap: int):
+    """Jit-friendly groupby: identical semantics to groupby_aggregate but a
+    static `key_cap` output size instead of the group-count host sync, so
+    whole pipelines fuse into one XLA program (the same padded contract as
+    parallel.distributed_groupby).
+
+    Returns (Table padded to key_cap rows, valid (key_cap,) bool, overflow
+    scalar). Rows past the real group count are garbage and masked by
+    `valid`; overflow True means key_cap was too small — retry bigger
+    (SplitAndRetry contract)."""
+    return groupby_aggregate(table, key_names, aggs, _cap=key_cap)

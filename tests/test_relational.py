@@ -330,3 +330,67 @@ def test_join_random_against_pandas():
     dr = pd.DataFrame({"k": rk, "ri": np.arange(nr)})
     ref = dl.merge(dr, on="k")
     assert got == sorted(zip(ref["li"].tolist(), ref["ri"].tolist()))
+
+
+def test_groupby_capped_matches_uncapped_under_jit():
+    import jax
+    from spark_rapids_tpu.ops import groupby_aggregate_capped
+    rng = np.random.default_rng(17)
+    n = 5000
+    t = Table([Column.from_numpy(rng.integers(0, 37, n).astype(np.int32)),
+               Column.from_numpy(rng.integers(-100, 100, n).astype(np.int64))],
+              names=["k", "v"])
+    ref = groupby_aggregate(t, ["k"], [("v", "sum"), ("v", "count"),
+                                       ("v", "min"), ("v", "mean")])
+
+    @jax.jit
+    def run(tb):
+        out, valid, overflow = groupby_aggregate_capped(
+            tb, ["k"], [("v", "sum"), ("v", "count"), ("v", "min"),
+                        ("v", "mean")], key_cap=64)
+        return [c.data for c in out.columns], valid, overflow
+
+    cols, valid, overflow = run(t)
+    assert not bool(overflow)
+    v = np.asarray(valid)
+    assert v.sum() == ref.num_rows
+    for got, want in zip(cols, ref.columns):
+        np.testing.assert_array_equal(np.asarray(got)[v],
+                                      np.asarray(want.data))
+
+    # overflow flags when the cap is too small
+    out2, valid2, overflow2 = groupby_aggregate_capped(
+        t, ["k"], [("v", "sum")], key_cap=8)
+    assert bool(overflow2)
+
+
+def test_groupby_capped_small_batch_and_overflow_retry():
+    from spark_rapids_tpu.ops import groupby_aggregate_capped
+    # cap larger than the batch: pads, never raises (fixed-cap jit pipeline)
+    t = Table([Column.from_numpy(np.array([3, 1, 3], np.int32)),
+               Column.from_numpy(np.array([10, 20, 30], np.int64))],
+              names=["k", "v"])
+    out, valid, overflow = groupby_aggregate_capped(
+        t, ["k"], [("v", "sum")], key_cap=64)
+    assert not bool(overflow)
+    v = np.asarray(valid)
+    assert v.sum() == 2
+    assert np.asarray(out.columns[0].data)[v].tolist() == [1, 3]
+    assert np.asarray(out.columns[1].data)[v].tolist() == [20, 40]
+    # retry-bigger converges even past n
+    n = 10
+    t2 = Table([Column.from_numpy(np.arange(n, dtype=np.int32)),
+                Column.from_numpy(np.ones(n, np.int64))], names=["k", "v"])
+    _, _, ov_small = groupby_aggregate_capped(t2, ["k"], [("v", "sum")],
+                                              key_cap=8)
+    assert bool(ov_small)
+    out2, valid2, ov_big = groupby_aggregate_capped(t2, ["k"], [("v", "sum")],
+                                                    key_cap=32)
+    assert not bool(ov_big) and int(np.asarray(valid2).sum()) == n
+    # empty table
+    t0 = Table([Column.from_numpy(np.zeros(0, np.int32)),
+                Column.from_numpy(np.zeros(0, np.int64))], names=["k", "v"])
+    out0, valid0, ov0 = groupby_aggregate_capped(t0, ["k"], [("v", "sum")],
+                                                 key_cap=4)
+    assert not bool(ov0) and not np.asarray(valid0).any()
+    assert out0.columns[0].length == 4
