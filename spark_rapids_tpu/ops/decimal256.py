@@ -20,14 +20,18 @@ NLIMBS = 8
 _M32 = jnp.uint64(0xFFFFFFFF)
 
 
-def from_int(values) -> jnp.ndarray:
-    """Host helper: python ints -> (n, 8) limbs (two's complement)."""
+def _from_int_np(values) -> np.ndarray:
     out = np.zeros((len(values), NLIMBS), np.uint64)
     for i, v in enumerate(values):
         u = int(v) & ((1 << 256) - 1)
         for j in range(NLIMBS):
             out[i, j] = (u >> (32 * j)) & 0xFFFFFFFF
-    return jnp.asarray(out)
+    return out
+
+
+def from_int(values) -> jnp.ndarray:
+    """Host helper: python ints -> (n, 8) limbs (two's complement)."""
+    return jnp.asarray(_from_int_np(values))
 
 
 def to_int(limbs) -> list:
@@ -165,9 +169,10 @@ _POW10_LIMBS = None
 def pow10_table() -> jnp.ndarray:
     global _POW10_LIMBS
     if _POW10_LIMBS is None:
-        # cached as a HOST array: caching a traced jnp value would leak the
-        # tracer into later jit traces
-        _POW10_LIMBS = np.asarray(from_int([10**k for k in range(77)]))
+        # cached as a HOST array, built with pure numpy: caching a traced
+        # jnp value would leak the tracer into later jit traces (and a cold
+        # cache inside a trace could not be converted back to numpy)
+        _POW10_LIMBS = _from_int_np([10**k for k in range(77)])
     return jnp.asarray(_POW10_LIMBS)
 
 
