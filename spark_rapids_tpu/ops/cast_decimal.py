@@ -37,7 +37,7 @@ import numpy as np
 
 from .. import dtypes
 from ..columnar import Column
-from ..dtypes import DType, Kind
+from ..dtypes import Kind
 from . import decimal256 as d256
 from .cast_string import CastError, _char_at, _first_idx, _is_ws, _raise_first_error
 

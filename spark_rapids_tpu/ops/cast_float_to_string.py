@@ -23,7 +23,6 @@ TPU-native design — no per-row char loop, everything is fused vector math:
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Tuple
 
 import jax

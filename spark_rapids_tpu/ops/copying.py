@@ -167,7 +167,6 @@ def drop_duplicates(table: Table,
                     key_names: Union[None, Sequence] = None) -> Table:
     """Distinct rows, keeping the FIRST occurrence in original row order
     (cudf::distinct KEEP_FIRST; Spark dropDuplicates)."""
-    from .aggregate import groupby_aggregate  # noqa: F401 (shared machinery)
     from .sort import _key_operands
     import jax
 

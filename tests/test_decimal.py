@@ -5,7 +5,6 @@ import decimal
 import random
 
 import numpy as np
-import pytest
 
 from spark_rapids_tpu import dtypes
 from spark_rapids_tpu.columnar import Column

@@ -2,7 +2,6 @@
 import numpy as np
 import jax
 import jax.numpy as jnp
-import pytest
 
 from spark_rapids_tpu.parallel import (partition_ids, exchange, make_mesh,
                                        repartition_table)

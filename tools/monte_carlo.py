@@ -24,7 +24,7 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import List
 
 sys.path.insert(0, ".")
 
@@ -35,7 +35,7 @@ import os  # noqa: E402
 import jax  # noqa: E402
 jax.config.update("jax_platforms", os.environ.get("SRT_MC_PLATFORM", "cpu"))
 
-from spark_rapids_tpu.runtime import (DeviceSession, HardOOM, MemoryBudget,  # noqa: E402
+from spark_rapids_tpu.runtime import (DeviceSession, HardOOM,  # noqa: E402
                                       Reservation, ResourceArbiter, with_retry)
 
 MIB = 1024 * 1024
