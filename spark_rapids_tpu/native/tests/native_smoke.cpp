@@ -284,12 +284,50 @@ static void test_parquet_nested(char const* path) {
   pqr_free(h);
 }
 
+// parse every truncation/corruption of a real file: must error or succeed,
+// never crash or over-read (the ASan build turns over-reads into failures)
+static void test_parquet_truncation_fuzz(char const* path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) {
+    std::fprintf(stderr, "SKIP parquet fuzz test: cannot open %s\n", path);
+    return;
+  }
+  std::vector<uint8_t> bytes((std::istreambuf_iterator<char>(f)),
+                             std::istreambuf_iterator<char>());
+  for (size_t cut = 0; cut < bytes.size(); cut += 97) {
+    void* h = pqr_open_ex(bytes.data(), int64_t(cut), 1);
+    if (h) {
+      int64_t nbytes = 0, present = 0;
+      for (int32_t leaf = 0; leaf < pqr_num_leaves(h) && leaf < 4; leaf++)
+        pqr_read_column(h, 0, leaf, nullptr, &nbytes, nullptr, nullptr,
+                        &present);
+      pqr_free(h);
+    }
+  }
+  // single-byte corruptions of the footer region
+  size_t const foot = bytes.size() > 512 ? bytes.size() - 512 : 0;
+  for (size_t i = foot; i < bytes.size(); i += 13) {
+    std::vector<uint8_t> mut = bytes;
+    mut[i] ^= 0x5A;
+    void* h = pqr_open_ex(mut.data(), int64_t(mut.size()), 1);
+    if (h) {
+      int64_t nbytes = 0, present = 0;
+      for (int32_t leaf = 0; leaf < pqr_num_leaves(h) && leaf < 4; leaf++)
+        pqr_read_column(h, 0, leaf, nullptr, &nbytes, nullptr, nullptr,
+                        &present);
+      pqr_free(h);
+    }
+  }
+  std::printf("parquet truncation/corruption fuzz OK\n");
+}
+
 int main(int argc, char** argv) {
   test_alloc_retry_block_wake();
   test_deadlock_escalates_to_retry_oom();
   test_injection();
   if (argc > 1) test_parquet(argv[1]);
   if (argc > 2) test_parquet_nested(argv[2]);
+  if (argc > 2) test_parquet_truncation_fuzz(argv[2]);
   if (g_failures) {
     std::fprintf(stderr, "%d native test failures\n", g_failures);
     return 1;
