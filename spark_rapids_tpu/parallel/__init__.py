@@ -1,13 +1,15 @@
 from .shuffle import (partition_ids, build_partition_map, exchange,
                       repartition_table, make_mesh)
 from .relational import (distributed_broadcast_join, distributed_groupby,
+                         distributed_groupby_multi,
                          distributed_inner_join, distributed_left_anti_join,
                          distributed_left_join, distributed_left_semi_join,
                          distributed_sort)
 
 __all__ = ["partition_ids", "build_partition_map", "exchange",
            "repartition_table", "make_mesh",
-           "distributed_groupby", "distributed_inner_join",
+           "distributed_groupby", "distributed_groupby_multi",
+           "distributed_inner_join",
            "distributed_broadcast_join", "distributed_left_join",
            "distributed_left_semi_join", "distributed_left_anti_join",
            "distributed_sort"]

@@ -40,14 +40,17 @@ _AGGS = ("sum", "count", "min", "max")
 _DEAD_KEY = jnp.iinfo(jnp.int64).max
 
 
-def _spark_murmur_i64(keys: jnp.ndarray) -> jnp.ndarray:
-    """Spark murmur3_32 (seed 42, like GpuHashPartitioning) of int64 keys."""
+def _spark_murmur_i64(keys) -> jnp.ndarray:
+    """Spark murmur3_32 (seed 42, like GpuHashPartitioning) of one or more
+    int64 key columns (chained per column, like Spark's hash of the key
+    tuple)."""
     from ..ops.hash import murmur_hash3_32
     from ..columnar import Column, Table
     from .. import dtypes
-    col = Column(dtype=dtypes.INT64, length=keys.shape[0],
-                 data=keys.astype(jnp.int64))
-    return murmur_hash3_32(Table([col]), seed=42).data
+    key_list = keys if isinstance(keys, (list, tuple)) else [keys]
+    cols = [Column(dtype=dtypes.INT64, length=k.shape[0],
+                   data=k.astype(jnp.int64)) for k in key_list]
+    return murmur_hash3_32(Table(cols), seed=42).data
 
 
 def _fit(x: jnp.ndarray, cap: int, fill) -> jnp.ndarray:
@@ -87,23 +90,29 @@ def _bucket_exchange(axis: str, n_peers: int, cap: int, part: jnp.ndarray,
     return outs, recv_valid, spilled
 
 
-def _merge_groups(keys: jnp.ndarray, alive: jnp.ndarray,
+def _merge_groups(keys, alive: jnp.ndarray,
                   cols: Sequence[Tuple[jnp.ndarray, str]], key_cap: int):
     """Shard-local merge of rows with equal keys (the shared kernel behind
     both the partial and final stages; same sorted-span machinery as
     ops/aggregate.py's scatter-free groupby).
 
-    cols: [(int64 column, merge op in sum|min|max)]. Dead rows (alive False)
-    are excluded. Returns (keys (key_cap,), outs [(key_cap,)], valid
-    (key_cap,), n_real_groups) — padded/sliced to exactly key_cap.
-    """
-    n = keys.shape[0]
+    `keys` is one int64 array or a list of them (multi-key groupby: rows
+    merge when ALL key columns are equal). cols: [(int64 column, merge op in
+    sum|min|max)]. Dead rows (alive False) are excluded. Returns
+    (keys like the input shape, outs [(key_cap,)], valid (key_cap,),
+    n_real_groups) — padded/sliced to exactly key_cap."""
+    multi = isinstance(keys, (list, tuple))
+    key_list = list(keys) if multi else [keys]
+    n = key_list[0].shape[0]
     iota = jnp.arange(n, dtype=jnp.int32)
-    k = jnp.where(alive, keys, _DEAD_KEY)     # dead rows sort last
-    sk, order = jax.lax.sort([k, iota], num_keys=1, is_stable=True)
+    ks = [jnp.where(alive, k, _DEAD_KEY) for k in key_list]  # dead rows last
+    sorted_all = jax.lax.sort([*ks, iota], num_keys=len(ks), is_stable=True)
+    sks, order = sorted_all[:-1], sorted_all[-1]
     salive = jnp.take(alive, order, axis=0)
 
-    neq = sk != jnp.roll(sk, 1)
+    neq = jnp.zeros((n,), bool)
+    for o in sks:
+        neq = neq | (o != jnp.roll(o, 1))
     boundary = neq.at[0].set(True) if n else neq
     gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
     # boundary-compaction sort for group starts (see ops/aggregate.py)
@@ -147,12 +156,12 @@ def _merge_groups(keys: jnp.ndarray, alive: jnp.ndarray,
     in_range = iota < n_groups
     n_real = jnp.sum((alive_cnt > 0) & in_range).astype(jnp.int32)
 
-    gkeys = jnp.take(sk, starts, axis=0, mode="clip")
     valid = (_fit(alive_cnt, key_cap, 0) > 0) & \
         (jnp.arange(key_cap, dtype=jnp.int32) < n_groups)
-    return (_fit(gkeys, key_cap, _DEAD_KEY),
-            [_fit(o, key_cap, 0) for o in outs],
-            valid, n_real)
+    gkeys = [_fit(jnp.take(k, starts, axis=0, mode="clip"), key_cap,
+                  _DEAD_KEY) for k in sks]
+    out_keys = gkeys if multi else gkeys[0]
+    return (out_keys, [_fit(o, key_cap, 0) for o in outs], valid, n_real)
 
 
 def distributed_groupby(mesh: Mesh, keys: jnp.ndarray, vals: jnp.ndarray,
@@ -164,46 +173,75 @@ def distributed_groupby(mesh: Mesh, keys: jnp.ndarray, vals: jnp.ndarray,
     `key_cap` bounds the distinct keys per shard at both stages (static
     shapes); the returned per-shard `overflow` flag means results are
     incomplete — retry with a bigger key_cap (SplitAndRetry contract).
-    Returns per-shard padded (keys, [agg arrays], valid, overflow)."""
-    for a in aggs:
+    Returns per-shard padded (keys, [agg arrays], valid, overflow).
+
+    Thin wrapper over distributed_groupby_multi (single key, single value
+    column)."""
+    (gk,), outs, valid, overflow = distributed_groupby_multi(
+        mesh, [keys], [vals], [(0, a) for a in aggs], key_cap, axis)
+    return gk, outs, valid, overflow
+
+
+def distributed_groupby_multi(mesh: Mesh, keys: Sequence[jnp.ndarray],
+                              vals: Sequence[jnp.ndarray],
+                              aggs: Sequence[Tuple[int, str]], key_cap: int,
+                              axis: str = "data"):
+    """Multi-key, multi-value groupby over the mesh — same two-stage shape
+    as distributed_groupby but grouping on a tuple of int64 key columns and
+    aggregating [(value index, op)] pairs.
+
+    Returns per-shard padded ([key arrays], [agg arrays], valid, overflow).
+    """
+    for _, a in aggs:
         if a not in _AGGS:
             raise ValueError(f"unsupported distributed agg {a!r}")
+    keys = list(keys)
+    vals = list(vals)
+    if not keys:
+        raise ValueError("at least one key column is required")
     n_peers = mesh.shape[axis]
-    aggs = tuple(aggs)
+    aggs = tuple((int(i), a) for i, a in aggs)
+    for i, a in aggs:
+        if a != "count" and not (0 <= i < len(vals)):
+            raise ValueError(f"agg value index {i} out of range "
+                             f"({len(vals)} value columns)")
 
-    def partial_cols(vals, alive):
-        ones = jnp.ones(vals.shape, jnp.int64)
-        return [(ones if a == "count" else vals,
-                 "sum" if a in ("sum", "count") else a) for a in aggs]
+    def partial_cols(key0, val_arrays):
+        ones = jnp.ones(key0.shape, jnp.int64)   # count needs no value column
+        return [(ones if a == "count" else val_arrays[i],
+                 "sum" if a in ("sum", "count") else a) for i, a in aggs]
 
     def merge_cols(partials):
         return [(p, "sum" if a in ("sum", "count") else a)
-                for p, a in zip(partials, aggs)]
+                for p, (_, a) in zip(partials, aggs)]
 
-    def local(keys, vals):
-        alive = jnp.ones(keys.shape, bool)
-        gk, partials, gvalid, n_real = _merge_groups(
-            keys, alive, partial_cols(vals, alive), key_cap)
+    nk = len(keys)
+
+    def local(*arrs):
+        ks, vs = list(arrs[:nk]), list(arrs[nk:])
+        alive = jnp.ones(ks[0].shape, bool)
+        gks, partials, gvalid, n_real = _merge_groups(
+            ks, alive, partial_cols(ks[0], vs), key_cap)
         overflow = n_real > key_cap
 
-        # route each surviving group to its owner peer; dead slots to the
-        # out-of-range partition so they never land in a bucket
-        part = partition_ids(_spark_murmur_i64(gk), n_peers)
+        part = partition_ids(_spark_murmur_i64(gks), n_peers)
         part = jnp.where(gvalid, part, jnp.int32(n_peers))
-        (recv_k, *recv_p), recv_alive, _ = _bucket_exchange(
+        recv, recv_alive, _ = _bucket_exchange(
             axis, n_peers, key_cap, part,
-            [(gk, _DEAD_KEY)] + [(p, _identity(op))
-                                 for p, op in merge_cols(partials)])
+            [(g, _DEAD_KEY) for g in gks] +
+            [(p, _identity(op)) for p, op in merge_cols(partials)])
+        recv_ks, recv_ps = recv[:nk], recv[nk:]
 
-        fk, fouts, fvalid, fn_real = _merge_groups(
-            recv_k, recv_alive, merge_cols(recv_p), key_cap)
+        fks, fouts, fvalid, fn_real = _merge_groups(
+            list(recv_ks), recv_alive, merge_cols(list(recv_ps)), key_cap)
         overflow = overflow | (fn_real > key_cap)
-        return fk, tuple(fouts), fvalid, overflow.reshape(1)  # rank-1 spec
+        return (tuple(fks), tuple(fouts), fvalid, overflow.reshape(1))
 
     spec = P(axis)
-    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec),
-                   out_specs=(spec, tuple(spec for _ in aggs), spec, spec))
-    return fn(keys, vals)
+    fn = shard_map(local, mesh=mesh, in_specs=(spec,) * (nk + len(vals)),
+                   out_specs=(tuple(spec for _ in keys),
+                              tuple(spec for _ in aggs), spec, spec))
+    return fn(*keys, *vals)
 
 
 def distributed_sort(mesh: Mesh, keys: jnp.ndarray, vals: jnp.ndarray,

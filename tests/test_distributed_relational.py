@@ -246,3 +246,47 @@ def test_distributed_semi_anti_join():
     got = sorted(np.asarray(av)[np.asarray(avalid)].tolist())
     want = sorted(int(v) for k, v in zip(lk, lv) if int(k) not in rset)
     assert got == want
+
+
+def test_distributed_groupby_multi_key():
+    from spark_rapids_tpu.parallel import distributed_groupby_multi
+    mesh = _mesh()
+    rng = np.random.default_rng(41)
+    n = NDEV * 48
+    k1 = rng.integers(0, 5, n).astype(np.int64)
+    k2 = rng.integers(0, 4, n).astype(np.int64)
+    v1 = rng.integers(-50, 50, n).astype(np.int64)
+    v2 = rng.integers(0, 1000, n).astype(np.int64)
+    sh = NamedSharding(mesh, P("data"))
+    args = [jax.device_put(jnp.asarray(x), sh) for x in (k1, k2, v1, v2)]
+    (gk1, gk2), (s1, c, m2), valid, overflow = distributed_groupby_multi(
+        mesh, args[:2], args[2:],
+        [(0, "sum"), (0, "count"), (1, "max")], key_cap=32)
+    assert not bool(jnp.any(overflow))
+    v = np.asarray(valid)
+    got = {(a, b): (x, y, z) for a, b, x, y, z in
+           zip(np.asarray(gk1)[v], np.asarray(gk2)[v], np.asarray(s1)[v],
+               np.asarray(c)[v], np.asarray(m2)[v])}
+    import collections
+    want = collections.defaultdict(lambda: [0, 0, -10**18])
+    for a, b, x, y in zip(k1, k2, v1, v2):
+        w = want[(a, b)]
+        w[0] += x; w[1] += 1; w[2] = max(w[2], y)
+    assert set(got) == set(want)
+    for key, (x, y, z) in got.items():
+        assert [int(x), int(y), int(z)] == [int(q) for q in want[key]], key
+
+
+def test_distributed_groupby_multi_count_only():
+    from spark_rapids_tpu.parallel import distributed_groupby_multi
+    mesh = _mesh()
+    sh = NamedSharding(mesh, P("data"))
+    k = jax.device_put(jnp.asarray(np.arange(NDEV * 8, dtype=np.int64) % 5),
+                       sh)
+    (gk,), (cnt,), valid, ov = distributed_groupby_multi(
+        mesh, [k], [], [(0, "count")], 16)
+    assert not bool(jnp.any(ov))
+    assert int(jnp.sum(jnp.where(valid, cnt, 0))) == NDEV * 8
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        distributed_groupby_multi(mesh, [k], [], [(0, "sum")], 16)
