@@ -1,0 +1,29 @@
+"""Driver interface guard: entry() must jit-compile and dryrun_multichip
+must run on the virtual mesh — regressions here would only surface in the
+driver's own validation otherwise."""
+import jax
+import numpy as np
+
+import spark_rapids_tpu  # noqa: F401  (enables x64)
+
+
+def test_entry_compiles_and_runs():
+    import __graft_entry__ as g
+    fn, args = g.entry()
+    h32, h64, product, overflow = jax.jit(fn)(*args)
+    assert h32.shape == h64.shape == (4096,)
+    assert product.shape == (4096, 4)
+    assert not np.asarray(overflow).any()
+    # decimal spot-check: unscaled v (scale 2) squared -> scale-4 unscaled v*v
+    vals = np.asarray(args[1])
+    row = np.asarray(product[7])
+    u = (int(row[0]) | int(row[1]) << 32 | int(row[2]) << 64
+         | int(row[3]) << 96)
+    if u >= 1 << 127:
+        u -= 1 << 128
+    assert u == int(vals[7]) ** 2
+
+
+def test_dryrun_multichip_eight():
+    import __graft_entry__ as g
+    g.dryrun_multichip(8)
