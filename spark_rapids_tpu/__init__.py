@@ -33,14 +33,18 @@ from .version import __version__, version_info
 __all__ = ["dtypes", "Column", "Table", "api", "__version__", "version_info"]
 
 
+_LAZY_SUBMODULES = ("api", "ops", "parallel", "io", "runtime", "interop",
+                    "columnar", "faultinj", "config")
+
+
 def __getattr__(name):
-    # `api` imports the whole ops package, whose module-level jnp constants
-    # initialize the JAX backend — lazy (PEP 562) so a bare
-    # `import spark_rapids_tpu` stays side-effect-free and callers can pin
-    # a platform first (a dead device tunnel would otherwise hang here).
-    if name == "api":
+    # Subpackages import modules whose module-level jnp constants initialize
+    # the JAX backend — lazy (PEP 562) so a bare `import spark_rapids_tpu`
+    # stays side-effect-free and callers can pin a platform first (a dead
+    # device tunnel would otherwise hang here).
+    if name in _LAZY_SUBMODULES:
         import importlib
-        return importlib.import_module(".api", __name__)
+        return importlib.import_module("." + name, __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 # Fault-injector auto-load (reference: libcufaultinj.so via
