@@ -190,6 +190,7 @@ def _wrap_op(name: str, fn: Callable) -> Callable:
     shim.__name__ = fn.__name__
     shim.__doc__ = fn.__doc__
     shim.__wrapped__ = fn
+    shim.__faultinj_shim__ = True
     return shim
 
 
@@ -212,7 +213,9 @@ def install(config_path: Optional[str] = None) -> FaultInjector:
     from . import ops
     for name in ops.__all__:
         fn = getattr(ops, name)
-        if callable(fn) and not hasattr(fn, "__wrapped__"):
+        # skip non-callables and our own shims (admission wrappers set
+        # __wrapped__ too, so that attr is no longer a valid skip marker)
+        if callable(fn) and not hasattr(fn, "__faultinj_shim__"):
             _saved_ops[name] = fn
             setattr(ops, name, _wrap_op(name, fn))
 

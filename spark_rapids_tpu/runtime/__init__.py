@@ -14,8 +14,13 @@ from .adaptor import (ResourceArbiter, OomInjectionType, current_thread_id,
 from .pool import (DeviceSession, MemoryBudget, MemoryEventHandler,
                    Reservation)
 from .retry import with_retry
+from .admission import (set_active_session, get_active_session,
+                        active_session, admitted_op, operand_nbytes)
+from .spill import SpillPool, SpillableBuffer
 
 __all__ = [
+    "set_active_session", "get_active_session", "active_session",
+    "admitted_op", "operand_nbytes", "SpillPool", "SpillableBuffer",
     "ResourceArbiter", "OomInjectionType", "current_thread_id",
     "ArbiterOOM", "RetryOOM", "SplitAndRetryOOM", "CpuRetryOOM",
     "CpuSplitAndRetryOOM", "HardOOM", "InjectedException", "ThreadRemovedError",

@@ -188,7 +188,11 @@ class ResourceArbiter:
         from ..config import retry_limit
         self._lib.sra_set_retry_limit(self._h, retry_limit())
         self._closed = False
-        self._close_lock = threading.Lock()
+        # RLock: dealloc (called from weakref finalizers) guards on this
+        # lock; a finalizer firing on the thread that is mid-close() must
+        # not self-deadlock. The native handle is live until the final
+        # destroy, so a reentrant dealloc during close is safe.
+        self._close_lock = threading.RLock()
         self._watchdog_stop = threading.Event()
         self._watchdog = None
         if watchdog:
@@ -359,7 +363,14 @@ class ResourceArbiter:
 
     def dealloc(self, is_cpu: bool = False) -> None:
         tid = current_thread_id()
-        self._check(self._lib.sra_dealloc(self._h, tid, int(is_cpu), tid))
+        # Admission reservations are released by weakref finalizers when op
+        # outputs are collected — which can be *after* the session closed and
+        # the native handle was destroyed. Gate on the close lock so a late
+        # free is a no-op instead of a use-after-free.
+        with self._close_lock:
+            if self._closed:
+                return
+            self._check(self._lib.sra_dealloc(self._h, tid, int(is_cpu), tid))
 
     def block_thread_until_ready(self) -> None:
         """Called after catching RetryOOM, before retrying (the contract in

@@ -71,7 +71,12 @@ class MemoryBudget:
         self.is_cpu = is_cpu
         self.event_handler = event_handler
         self._used = 0
-        self._mu = threading.Lock()
+        # RLock: releases run from weakref finalizers, which can fire via GC
+        # on a thread that is already inside one of our critical sections; a
+        # plain Lock would self-deadlock. The interleaving is benign — every
+        # section is short arithmetic whose checks stay conservative when
+        # _used shrinks mid-section.
+        self._mu = threading.RLock()
 
     @property
     def used(self) -> int:
@@ -150,6 +155,29 @@ class MemoryBudget:
         if blocking and not retry:
             raise HardOOM(f"allocation of {nbytes} failed and retry is not possible")
         return None
+
+    def resize(self, r: Reservation, nbytes: int) -> None:
+        """Shrink (or best-effort grow) a live reservation to `nbytes`.
+
+        The admission layer reserves a pre-dispatch working-set estimate and
+        shrinks to the outputs' true bytes once they exist — the analogue of
+        transient kernel scratch being freed at kernel end while the output
+        allocation stays. Shrinking always succeeds and wakes blocked
+        threads; growing takes only what fits (no blocking here: the grow
+        path is advisory)."""
+        nbytes = int(nbytes)
+        with self._mu:
+            if r._released:
+                return
+            delta = nbytes - r.nbytes
+            if delta > 0 and self._used + delta > self.limit:
+                return  # advisory grow did not fit; keep the old size
+            self._used += delta
+            r.nbytes = nbytes
+        if delta < 0:
+            self.arbiter.dealloc(is_cpu=self.is_cpu)
+            if self.event_handler is not None:
+                self.event_handler.on_deallocated(self.used)
 
     def release(self, r: Reservation) -> None:
         with self._mu:

@@ -1,0 +1,156 @@
+"""Spillable device buffers + the MemoryEventHandler that frees them.
+
+The reference's allocator chain has an event-handler adaptor between the
+arbiter and the pool (`RmmEventHandlerResourceAdaptor`, SURVEY.md §3.2): on
+allocation failure the plugin's handler makes cached buffers spillable/frees
+them and returns true so the allocation retries immediately, *before* the
+task-level blocking state machine engages. `SpillPool` is that handler made
+real for HBM: registered buffers are copied to host numpy and their device
+arrays deleted (`jax.Array.delete()` actually drops the HBM buffer), their
+reservations returned to the budget.
+
+Restore (`SpillableBuffer.get`) re-admits through the budget, so a restore
+under pressure can itself trigger further spills or the retry protocol —
+the same recursion the reference guards in `pre_alloc_core`
+(SparkResourceAdaptorJni.cpp:1238-1265); the arbiter's recursive-allocation
+detection makes it safe here too.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from .admission import array_nbytes
+from .pool import MemoryBudget, MemoryEventHandler, Reservation
+
+
+class SpillableBuffer:
+    """One device array whose residency is budget-backed and revocable."""
+
+    def __init__(self, pool: "SpillPool", array: jax.Array,
+                 reservation: Reservation):
+        self._pool = pool
+        self._device = array
+        self._host: Optional[np.ndarray] = None
+        self._reservation: Optional[Reservation] = reservation
+        self.nbytes = array_nbytes(array)
+        self._mu = threading.Lock()
+
+    @property
+    def spilled(self) -> bool:
+        with self._mu:
+            return self._device is None
+
+    def spill(self) -> int:
+        """Move to host, delete the device buffer, free the budget.
+        Returns bytes freed (0 if already spilled)."""
+        with self._mu:
+            if self._device is None:
+                return 0
+            self._host = np.asarray(self._device)     # D2H copy
+            self._device.delete()                     # drop the HBM buffer
+            self._device = None
+            r, self._reservation = self._reservation, None
+        self._pool.budget.release(r)
+        return self.nbytes
+
+    def get(self) -> jax.Array:
+        """The live device array; restores (re-admitting budget) if spilled.
+
+        Loops: the buffer can be re-spilled between our restore attempt and
+        the return (another thread's alloc failure), and a race-lost restore
+        must re-read under the lock — never hand out a deleted array."""
+        import jax.numpy as jnp
+        while True:
+            with self._mu:
+                if self._device is not None:
+                    return self._device
+                host = self._host
+            # acquire outside our own lock: admission may call back into the
+            # pool's on_alloc_failure, which takes other buffers' locks
+            r = self._pool.budget.acquire(self.nbytes)
+            dev = jnp.asarray(host)
+            with self._mu:
+                if self._device is None:
+                    self._device = dev
+                    self._host = None
+                    self._reservation = r
+                    return dev
+            # lost a restore race; give the budget back and re-check
+            self._pool.budget.release(r)
+            dev.delete()
+
+    def close(self) -> None:
+        with self._mu:
+            if self._device is not None:
+                self._device.delete()
+                self._device = None
+            self._host = None
+            r, self._reservation = self._reservation, None
+        if r is not None:
+            self._pool.budget.release(r)
+
+
+class SpillPool(MemoryEventHandler):
+    """Registry of spillable buffers; spills oldest-first on alloc failure."""
+
+    def __init__(self):
+        self.budget: Optional[MemoryBudget] = None   # set by attach()
+        self._mu = threading.Lock()
+        self._buffers: Dict[int, SpillableBuffer] = {}
+        self._next_id = 0
+        self.spill_count = 0
+        self.spilled_bytes = 0
+
+    def attach(self, budget: MemoryBudget) -> "SpillPool":
+        self.budget = budget
+        budget.event_handler = self
+        return self
+
+    def register(self, array: jax.Array) -> SpillableBuffer:
+        """Admit an already-materialized device array into the pool: its
+        bytes are charged to the budget and become revocable."""
+        assert self.budget is not None, "attach() a budget first"
+        r = self.budget.acquire(array_nbytes(array))
+        buf = SpillableBuffer(self, array, r)
+        with self._mu:
+            buf._id = self._next_id
+            self._next_id += 1
+            self._buffers[buf._id] = buf
+        return buf
+
+    def unregister(self, buf: SpillableBuffer) -> None:
+        with self._mu:
+            self._buffers.pop(getattr(buf, "_id", -1), None)
+        buf.close()
+
+    # -- MemoryEventHandler ---------------------------------------------------
+    def on_alloc_failure(self, nbytes: int, retry_count: int) -> bool:
+        """Spill buffers oldest-first until `nbytes` are freed. True iff any
+        bytes were freed (the RmmEventHandlerResourceAdaptor contract:
+        true → retry the allocation immediately). Serialized under the pool
+        lock so concurrent alloc failures do not over-spill or race the
+        counters; individual spills release budget via each buffer's own
+        lock, which is never taken while holding another buffer's."""
+        freed = 0
+        with self._mu:
+            candidates = [b for _, b in sorted(self._buffers.items())
+                          if not b.spilled]
+            for b in candidates:
+                freed += b.spill()
+                if freed >= nbytes:
+                    break
+            if freed > 0:
+                self.spill_count += 1
+                self.spilled_bytes += freed
+        return freed > 0
+
+    def close(self) -> None:
+        with self._mu:
+            bufs = list(self._buffers.values())
+            self._buffers.clear()
+        for b in bufs:
+            b.close()

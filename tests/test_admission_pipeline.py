@@ -1,0 +1,164 @@
+"""End-to-end: real ops crossing the memory arbiter.
+
+Round-1 verdict: the arbiter was "an island" — no op ever called
+`MemoryBudget.acquire`. These tests prove the round-2 wiring: every public
+Table op admits its working set through the active `DeviceSession`
+(runtime/admission.py), pressure drives the reference's recovery contract
+(RetryOOM → rollback → block-until-ready → SplitAndRetryOOM → halve —
+RmmSpark.java:402-416), and the spill handler frees *real* HBM buffers
+(`jax.Array.delete`), mirroring RmmEventHandlerResourceAdaptor in the
+reference's allocator chain (SURVEY.md §3.2).
+"""
+import gc
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import Column, Table, dtypes
+from spark_rapids_tpu.ops import (concat_tables, groupby_aggregate,
+                                  halve_table, murmur_hash3_32)
+from spark_rapids_tpu.runtime import (DeviceSession, RetryOOM, SpillPool,
+                                      operand_nbytes, set_active_session,
+                                      with_retry)
+
+from test_resource_adaptor import TaskActor
+
+MiB = 1024 * 1024
+
+
+@pytest.fixture()
+def no_global_session():
+    yield
+    set_active_session(None)
+
+
+def _sales_table(n=40_000, n_items=50, seed=7):
+    rng = np.random.default_rng(seed)
+    items = rng.integers(0, n_items, n).astype(np.int64)
+    rev = rng.random(n)
+    t = Table([Column.from_numpy(items), Column.from_numpy(rev)],
+              names=["item", "rev"])
+    pdf = pd.DataFrame({"item": items, "rev": rev})
+    return t, pdf
+
+
+def test_ops_pass_through_without_session():
+    # no active session → zero-cost pass-through (the reference only
+    # arbitrates once setEventHandler installs the adaptor)
+    assert getattr(groupby_aggregate, "__admitted__", False)
+    t, pdf = _sales_table(n=1_000)
+    agg = groupby_aggregate(t, ["item"], [("rev", "sum")])
+    assert agg[0].length == pdf.item.nunique()
+
+
+def test_pipeline_survives_small_budget(no_global_session):
+    """The round-2 mandate test: a groupby whose working set does not fit
+    the HBM budget survives via RetryOOM → with_retry → halve_table and
+    still produces oracle-exact results, with ≥1 retry recorded."""
+    table, pdf = _sales_table()
+    input_bytes = operand_nbytes(table)
+    # admission reserves 2.0× input bytes for a groupby; budget admits one
+    # half-batch but not the full batch
+    limit = input_bytes + input_bytes // 2
+    session = DeviceSession(limit)
+    with session:
+        set_active_session(session)
+        actor = TaskActor(session, task_id=1).start()
+        try:
+            def attempt(t):
+                return groupby_aggregate(
+                    t, ["item"], [("rev", "sum"), ("rev", "count")])
+
+            parts = actor.run(
+                lambda: with_retry(session.arbiter, attempt, table,
+                                   split=halve_table),
+                timeout=120)
+            # the full batch cannot be admitted: it must have split
+            assert len(parts) >= 2
+            retries = session.arbiter.get_and_reset_num_retry_throw(1)
+            splits = session.arbiter.get_and_reset_num_split_retry_throw(1)
+            assert retries >= 1
+            assert splits >= 1
+
+            # merge the partial aggregates (second-phase agg, still admitted)
+            def merge():
+                cat = concat_tables(
+                    [Table(list(p), names=["item", "s", "c"]) for p in parts])
+                return groupby_aggregate(cat, ["item"],
+                                         [("s", "sum"), ("c", "sum")])
+
+            final = actor.run(merge)
+        finally:
+            actor.done()
+
+        oracle = pdf.groupby("item").agg(s=("rev", "sum"), c=("rev", "count"))
+        got = {int(k): (s, c) for k, s, c in zip(
+            final[0].to_pylist(), final[1].to_pylist(), final[2].to_pylist())}
+        assert set(got) == set(oracle.index)
+        for item, row in oracle.iterrows():
+            s, c = got[int(item)]
+            assert c == row.c
+            np.testing.assert_allclose(s, row.s, rtol=1e-12)
+
+
+def test_spill_pool_frees_real_device_buffers(no_global_session):
+    """Registered cache buffers are actually deleted from the device on
+    pressure (handler returns True → the reservation retries immediately,
+    with NO task-level RetryOOM — the RmmEventHandlerResourceAdaptor
+    fast path)."""
+    session = DeviceSession(1 * MiB)
+    pool = SpillPool().attach(session.device)
+    with session:
+        set_active_session(session)
+        actor = TaskActor(session, task_id=3).start()
+        try:
+            cached = jnp.arange(75_000, dtype=jnp.int64)     # ~600 KiB
+            buf = actor.run(lambda: pool.register(cached))
+            del cached
+            assert session.device.used == buf.nbytes
+
+            t = Table([Column.from_numpy(
+                np.arange(40_000, dtype=np.int64))])          # 320 KiB input
+            # murmur admission wants 1.5×320 KiB; 600 KiB cached + 480 KiB
+            # > 1 MiB → the handler must spill, then the op proceeds
+            h = actor.run(lambda: murmur_hash3_32(t, seed=42))
+            assert h.length == 40_000
+            assert buf.spilled
+            assert pool.spill_count == 1
+            assert pool.spilled_bytes == buf.nbytes
+            # fast path: no task-level retry was thrown
+            assert session.arbiter.get_and_reset_num_retry_throw(3) == 0
+
+            # restore re-admits through the budget and round-trips the data
+            restored = actor.run(buf.get)
+            np.testing.assert_array_equal(np.asarray(restored),
+                                          np.arange(75_000, dtype=np.int64))
+            assert not buf.spilled
+            actor.run(lambda: pool.unregister(buf))
+            assert session.device.used > 0   # op output still holds its bytes
+        finally:
+            actor.done()
+
+
+def test_reservation_follows_output_lifetime(no_global_session):
+    """After an op returns, its reservation is shrunk to the outputs' true
+    bytes; when the outputs are collected the budget drains to zero (the
+    do_deallocate analogue: frees wake the budget)."""
+    session = DeviceSession(10 * MiB)
+    with session:
+        set_active_session(session)
+        actor = TaskActor(session, task_id=5).start()
+        try:
+            col = Column.from_numpy(np.arange(10_000, dtype=np.int64))
+            out = actor.run(lambda: murmur_hash3_32(Table([col]), seed=42))
+            assert session.device.used == operand_nbytes(out)
+            assert 0 < session.device.used < operand_nbytes(col)
+            del out
+            actor.run(lambda: None)   # flush the actor's last-result ref
+            gc.collect()
+            assert session.device.used == 0
+        finally:
+            actor.done()
