@@ -1,14 +1,20 @@
 from .shuffle import (partition_ids, build_partition_map, exchange,
                       repartition_table, make_mesh)
 from .relational import (distributed_broadcast_join, distributed_groupby,
-                         distributed_groupby_multi,
-                         distributed_inner_join, distributed_left_anti_join,
+                         distributed_groupby_keyed, distributed_groupby_multi,
+                         distributed_inner_join, distributed_inner_join_keyed,
+                         distributed_left_anti_join,
                          distributed_left_join, distributed_left_semi_join,
                          distributed_sort)
+from .keys import (KeySpec, encode_key_column, encode_key_columns,
+                   decode_key_columns, spark_partition_hash)
 
 __all__ = ["partition_ids", "build_partition_map", "exchange",
            "repartition_table", "make_mesh",
            "distributed_groupby", "distributed_groupby_multi",
+           "distributed_groupby_keyed", "distributed_inner_join_keyed",
+           "KeySpec", "encode_key_column", "encode_key_columns",
+           "decode_key_columns", "spark_partition_hash",
            "distributed_inner_join",
            "distributed_broadcast_join", "distributed_left_join",
            "distributed_left_semi_join", "distributed_left_anti_join",

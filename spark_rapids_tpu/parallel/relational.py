@@ -185,10 +185,15 @@ def distributed_groupby(mesh: Mesh, keys: jnp.ndarray, vals: jnp.ndarray,
 def distributed_groupby_multi(mesh: Mesh, keys: Sequence[jnp.ndarray],
                               vals: Sequence[jnp.ndarray],
                               aggs: Sequence[Tuple[int, str]], key_cap: int,
-                              axis: str = "data"):
+                              axis: str = "data", hash_fn=None):
     """Multi-key, multi-value groupby over the mesh — same two-stage shape
     as distributed_groupby but grouping on a tuple of int64 key columns and
     aggregating [(value index, op)] pairs.
+
+    `hash_fn(key_arrays) -> (n,) hash` overrides the partition hash (the
+    typed-key path passes keys.spark_partition_hash so string/decimal keys
+    place exactly like GpuHashPartitioning); default is the chained murmur
+    over raw int64 words.
 
     Returns per-shard padded ([key arrays], [agg arrays], valid, overflow).
     """
@@ -224,7 +229,7 @@ def distributed_groupby_multi(mesh: Mesh, keys: Sequence[jnp.ndarray],
             ks, alive, partial_cols(ks[0], vs), key_cap)
         overflow = n_real > key_cap
 
-        part = partition_ids(_spark_murmur_i64(gks), n_peers)
+        part = partition_ids((hash_fn or _spark_murmur_i64)(gks), n_peers)
         part = jnp.where(gvalid, part, jnp.int32(n_peers))
         recv, recv_alive, _ = _bucket_exchange(
             axis, n_peers, key_cap, part,
@@ -242,6 +247,22 @@ def distributed_groupby_multi(mesh: Mesh, keys: Sequence[jnp.ndarray],
                    out_specs=(tuple(spec for _ in keys),
                               tuple(spec for _ in aggs), spec, spec))
     return fn(*keys, *vals)
+
+
+def distributed_groupby_keyed(mesh: Mesh, key_words: Sequence[jnp.ndarray],
+                              key_specs, vals: Sequence[jnp.ndarray],
+                              aggs: Sequence[Tuple[int, str]], key_cap: int,
+                              axis: str = "data"):
+    """Typed-key groupby: key columns of ANY supported dtype (string,
+    decimal128, float, nullable int — see parallel/keys.py) encoded as word
+    lists ride the same SPMD program as the int64 path; partition placement
+    is Spark-exact (keys.spark_partition_hash). Returns per-shard padded
+    ([key word arrays], [agg arrays], valid, overflow); decode the words
+    with keys.decode_key_columns(words, specs, alive=valid)."""
+    from .keys import spark_partition_hash
+    return distributed_groupby_multi(
+        mesh, key_words, vals, aggs, key_cap, axis,
+        hash_fn=lambda ws: spark_partition_hash(ws, key_specs))
 
 
 def distributed_sort(mesh: Mesh, keys: jnp.ndarray, vals: jnp.ndarray,
@@ -300,24 +321,33 @@ def distributed_sort(mesh: Mesh, keys: jnp.ndarray, vals: jnp.ndarray,
     return fn(keys, vals)
 
 
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
 def _local_join_tail(lk, lv, lalive, rk, rv, ralive, row_cap: int,
                      outer: bool = False):
     """Shard-local (inner or left-outer) join into a fixed row_cap: union
     rank + sort-merge spans + padded expansion (ops/join.py machinery on
-    shard-local shapes). Returns (lkey, lval, rval, rmatched, live,
+    shard-local shapes). Key sides may be single arrays or word lists
+    (typed keys encoded by parallel/keys.py): rows match when ALL words are
+    equal. Returns (lkeys list, lvals list, rvals list, rmatched, live,
     overflow-scalar); rmatched is False on left-outer rows with no match
-    (their rval slot is 0 and must be read as null)."""
+    (their rval slots are 0 and must be read as null)."""
     from ..ops.join import _expand, _match_spans, _union_ranks
-    nl = lk.shape[0]
+    lks, rks = _as_list(lk), _as_list(rk)
+    lvs, rvs = _as_list(lv), _as_list(rv)
+    nl = lks[0].shape[0]
     if outer:
         # dead (padded) rows also get an output slot under outer expansion's
         # eff=max(counts,1): push them to the END so live slots form a
         # prefix that a single `< total_live` mask selects
         order = jnp.argsort(~lalive, stable=True)
-        lk = jnp.take(lk, order, axis=0)
-        lv = jnp.take(lv, order, axis=0)
+        lks = [jnp.take(k, order, axis=0) for k in lks]
+        lvs = [jnp.take(v, order, axis=0) for v in lvs]
         lalive = jnp.take(lalive, order, axis=0)
-    ranks = _union_ranks((jnp.concatenate([lk, rk]),), n_ops=1)
+    operands = tuple(jnp.concatenate([a, b]) for a, b in zip(lks, rks))
+    ranks = _union_ranks(operands, n_ops=len(operands))
     counts, lo, rorder = _match_spans(ranks[:nl], lalive, ranks[nl:], ralive)
     lsel, rsel = _expand(counts, lo, rorder, total=row_cap, outer=outer)
     if outer:
@@ -326,28 +356,34 @@ def _local_join_tail(lk, lv, lalive, rk, rv, ralive, row_cap: int,
         total = jnp.sum(counts)
     live = jnp.arange(row_cap, dtype=jnp.int32) < total
     rmatched = rsel >= 0 if outer else jnp.ones((row_cap,), bool)
-    out_lk = jnp.where(live, jnp.take(lk, lsel, axis=0), 0)
-    out_lv = jnp.where(live, jnp.take(lv, lsel, axis=0), 0)
+    out_lks = [jnp.where(live, jnp.take(k, lsel, axis=0), 0) for k in lks]
+    out_lvs = [jnp.where(live, jnp.take(v, lsel, axis=0), 0) for v in lvs]
     safe_rsel = jnp.maximum(rsel, 0)
-    out_rv = jnp.where(live & rmatched, jnp.take(rv, safe_rsel, axis=0), 0)
-    return out_lk, out_lv, out_rv, rmatched & live, live, total > row_cap
+    out_rvs = [jnp.where(live & rmatched, jnp.take(v, safe_rsel, axis=0), 0)
+               for v in rvs]
+    return out_lks, out_lvs, out_rvs, rmatched & live, live, total > row_cap
 
 
 def _hash_exchange(axis: str, n_peers: int, slack: float,
-                   keys: jnp.ndarray, vals):
+                   keys, vals, hash_fn=None):
     """Hash-partition by Spark murmur pmod and all-to-all one table side
-    (the shared shuffle wiring of every distributed join). `vals` may be
-    None (key-only sides, e.g. semi/anti build side)."""
-    nloc = keys.shape[0]
+    (the shared shuffle wiring of every distributed join). `keys` may be a
+    single int64 array or a word list (typed keys); `vals` may be None
+    (key-only sides, e.g. semi/anti build side), one array, or a list.
+    Returns (key outs, val outs, alive, spilled)."""
+    key_list = _as_list(keys)
+    val_list = [] if vals is None else _as_list(vals)
+    nloc = key_list[0].shape[0]
     cap = max(1, math.ceil(nloc / n_peers * slack))
-    part = partition_ids(_spark_murmur_i64(keys), n_peers)
-    payloads = [(keys, _DEAD_KEY)] + ([(vals, 0)] if vals is not None else [])
+    part = partition_ids((hash_fn or _spark_murmur_i64)(key_list), n_peers)
+    payloads = [(k, _DEAD_KEY) for k in key_list] + [(v, 0) for v in val_list]
     outs, alive, spilled = _bucket_exchange(axis, n_peers, cap, part, payloads)
     # a spill anywhere means some shard RECEIVED an incomplete side: agree on
     # the flag across the mesh (same contract as distributed_sort) so the
     # shard whose output is wrong also reports overflow
     spilled = jax.lax.all_gather(spilled.reshape(1), axis).any()
-    return outs, alive, spilled
+    nk = len(key_list)
+    return outs[:nk], outs[nk:], alive, spilled
 
 
 def distributed_inner_join(mesh: Mesh, lkeys: jnp.ndarray, lvals: jnp.ndarray,
@@ -365,18 +401,68 @@ def distributed_inner_join(mesh: Mesh, lkeys: jnp.ndarray, lvals: jnp.ndarray,
     n_peers = mesh.shape[axis]
 
     def local(lk, lv, rk, rv):
-        (Lk, Lv), Lalive, lspill = _hash_exchange(axis, n_peers, slack, lk, lv)
-        (Rk, Rv), Ralive, rspill = _hash_exchange(axis, n_peers, slack, rk, rv)
-
+        (Lk,), (Lv,), Lalive, lspill = _hash_exchange(
+            axis, n_peers, slack, lk, lv)
+        (Rk,), (Rv,), Ralive, rspill = _hash_exchange(
+            axis, n_peers, slack, rk, rv)
         out_lk, out_lv, out_rv, _, live, joverflow = _local_join_tail(
             Lk, Lv, Lalive, Rk, Rv, Ralive, row_cap)
         overflow = joverflow | lspill | rspill
-        return out_lk, out_lv, out_rv, live, overflow.reshape(1)
+        return out_lk[0], out_lv[0], out_rv[0], live, overflow.reshape(1)
 
     spec = P(axis)
     fn = shard_map(local, mesh=mesh, in_specs=(spec,) * 4,
                    out_specs=(spec,) * 5)
     return fn(lkeys, lvals, rkeys, rvals)
+
+
+def distributed_inner_join_keyed(mesh: Mesh, l_words: Sequence[jnp.ndarray],
+                                 lvals: Sequence[jnp.ndarray],
+                                 r_words: Sequence[jnp.ndarray],
+                                 rvals: Sequence[jnp.ndarray],
+                                 key_specs, row_cap: int, slack: float = 2.0,
+                                 axis: str = "data"):
+    """Typed-key inner join: key sides are word lists from
+    keys.encode_key_columns (string/decimal128/float/nullable keys all ride
+    the same machinery); placement is Spark-exact via
+    keys.spark_partition_hash. Returns per-shard padded
+    ([l key words], [lvals], [rvals], valid, overflow) — decode the key
+    words back to typed columns with keys.decode_key_columns."""
+    from .keys import spark_partition_hash
+    n_peers = mesh.shape[axis]
+    hash_fn = lambda ws: spark_partition_hash(ws, key_specs)  # noqa: E731
+    l_words, lvals = list(l_words), list(lvals)
+    r_words, rvals = list(r_words), list(rvals)
+    if len(r_words) != len(l_words):
+        # encode both sides with the SAME static max_bytes — auto-derived
+        # widths differ per side and would silently mis-slice the arg tuple
+        raise ValueError(
+            f"join key word counts differ: left {len(l_words)} vs right "
+            f"{len(r_words)}; encode both sides with identical KeySpecs")
+    nw, nlv = len(l_words), len(lvals)
+
+    def local(*arrs):
+        lw = list(arrs[:nw])
+        lv = list(arrs[nw:nw + nlv])
+        rw = list(arrs[nw + nlv:nw + nlv + nw])
+        rv = list(arrs[nw + nlv + nw:])
+        Lw, Lv, Lalive, lspill = _hash_exchange(
+            axis, n_peers, slack, lw, lv, hash_fn)
+        Rw, Rv, Ralive, rspill = _hash_exchange(
+            axis, n_peers, slack, rw, rv, hash_fn)
+        out_lw, out_lv, out_rv, _, live, joverflow = _local_join_tail(
+            Lw, Lv, Lalive, Rw, Rv, Ralive, row_cap)
+        overflow = joverflow | lspill | rspill
+        return (tuple(out_lw), tuple(out_lv), tuple(out_rv), live,
+                overflow.reshape(1))
+
+    spec = P(axis)
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(spec,) * (2 * nw + nlv + len(rvals)),
+        out_specs=(tuple(spec for _ in l_words), tuple(spec for _ in lvals),
+                   tuple(spec for _ in rvals), spec, spec))
+    return fn(*l_words, *lvals, *r_words, *rvals)
 
 
 def distributed_broadcast_join(mesh: Mesh, lkeys: jnp.ndarray,
@@ -402,7 +488,7 @@ def distributed_broadcast_join(mesh: Mesh, lkeys: jnp.ndarray,
         all_r = jnp.ones((Rk.shape[0],), jnp.bool_)
         out_lk, out_lv, out_rv, _, live, overflow = _local_join_tail(
             lk, lv, all_l, Rk, Rv, all_r, row_cap)
-        return out_lk, out_lv, out_rv, live, overflow.reshape(1)
+        return out_lk[0], out_lv[0], out_rv[0], live, overflow.reshape(1)
 
     spec = P(axis)
     fn = shard_map(local, mesh=mesh, in_specs=(spec,) * 4,
@@ -422,12 +508,14 @@ def distributed_left_join(mesh: Mesh, lkeys: jnp.ndarray, lvals: jnp.ndarray,
     n_peers = mesh.shape[axis]
 
     def local(lk, lv, rk, rv):
-        (Lk, Lv), Lalive, lspill = _hash_exchange(axis, n_peers, slack, lk, lv)
-        (Rk, Rv), Ralive, rspill = _hash_exchange(axis, n_peers, slack, rk, rv)
+        (Lk,), (Lv,), Lalive, lspill = _hash_exchange(
+            axis, n_peers, slack, lk, lv)
+        (Rk,), (Rv,), Ralive, rspill = _hash_exchange(
+            axis, n_peers, slack, rk, rv)
         out_lk, out_lv, out_rv, rvalid, live, joverflow = _local_join_tail(
             Lk, Lv, Lalive, Rk, Rv, Ralive, row_cap, outer=True)
         overflow = joverflow | lspill | rspill
-        return out_lk, out_lv, out_rv, rvalid, live, overflow.reshape(1)
+        return out_lk[0], out_lv[0], out_rv[0], rvalid, live, overflow.reshape(1)
 
     spec = P(axis)
     fn = shard_map(local, mesh=mesh, in_specs=(spec,) * 4,
@@ -442,8 +530,10 @@ def _distributed_semi_anti(mesh, lkeys, lvals, rkeys, semi, slack, axis):
     n_peers = mesh.shape[axis]
 
     def local(lk, lv, rk):
-        (Lk, Lv), Lalive, lspill = _hash_exchange(axis, n_peers, slack, lk, lv)
-        (Rk,), Ralive, rspill = _hash_exchange(axis, n_peers, slack, rk, None)
+        (Lk,), (Lv,), Lalive, lspill = _hash_exchange(
+            axis, n_peers, slack, lk, lv)
+        (Rk,), _, Ralive, rspill = _hash_exchange(
+            axis, n_peers, slack, rk, None)
         nl = Lk.shape[0]
         ranks = _union_ranks((jnp.concatenate([Lk, Rk]),), n_ops=1)
         counts, _, _ = _match_spans(ranks[:nl], Lalive, ranks[nl:], Ralive)
