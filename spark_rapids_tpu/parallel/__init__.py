@@ -8,6 +8,12 @@ from .relational import (distributed_broadcast_join, distributed_groupby,
                          distributed_sort)
 from .keys import (KeySpec, encode_key_column, encode_key_columns,
                    decode_key_columns, spark_partition_hash)
+from .autoretry import (CapacityOverflowError, auto_retry_overflow,
+                        distributed_groupby_auto,
+                        distributed_groupby_keyed_auto,
+                        distributed_inner_join_auto,
+                        distributed_inner_join_keyed_auto,
+                        distributed_left_join_auto, distributed_sort_auto)
 
 __all__ = ["partition_ids", "build_partition_map", "exchange",
            "repartition_table", "make_mesh",
@@ -15,6 +21,10 @@ __all__ = ["partition_ids", "build_partition_map", "exchange",
            "distributed_groupby_keyed", "distributed_inner_join_keyed",
            "KeySpec", "encode_key_column", "encode_key_columns",
            "decode_key_columns", "spark_partition_hash",
+           "CapacityOverflowError", "auto_retry_overflow",
+           "distributed_groupby_auto", "distributed_groupby_keyed_auto",
+           "distributed_inner_join_auto", "distributed_inner_join_keyed_auto",
+           "distributed_left_join_auto", "distributed_sort_auto",
            "distributed_inner_join",
            "distributed_broadcast_join", "distributed_left_join",
            "distributed_left_semi_join", "distributed_left_anti_join",

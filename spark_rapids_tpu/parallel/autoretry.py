@@ -1,0 +1,127 @@
+"""Driver-side SplitAndRetry for the distributed ops.
+
+Every `distributed_*` op returns an overflow flag instead of corrupting
+when a static capacity (key_cap / row_cap / slack) is exceeded — the mesh
+analogue of the arbiter's SplitAndRetryOOM (SURVEY.md §5: "split its input
+batch and retry"). Round 1 left acting on that flag to the caller; these
+wrappers close the loop: run the op, and on overflow grow the capacities
+and re-run. Capacities are static shapes, so each retry compiles a new SPMD
+program — the retry cost is a compile, never wrong data, and the doubled
+caps are remembered by jit's cache for the rest of the job (exactly how a
+Spark task that hit SplitAndRetryOOM keeps its smaller batch size).
+
+The growth is geometric (×2 per attempt, like halve_table's halving in
+reverse); `max_attempts` bounds the escalation the way the arbiter's
+retry limit bounds livelock (SparkResourceAdaptorJni.cpp:984-995).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax.numpy as jnp
+
+from .relational import (distributed_groupby, distributed_groupby_keyed,
+                         distributed_inner_join, distributed_inner_join_keyed,
+                         distributed_left_join, distributed_sort)
+
+
+class CapacityOverflowError(RuntimeError):
+    """Retries exhausted with the overflow flag still set."""
+
+
+def _grown(caps: Dict, grow: float) -> Dict:
+    out = {}
+    for k, v in caps.items():
+        if isinstance(v, int):
+            out[k] = max(v + 1, int(v * grow))
+        else:
+            out[k] = v * grow
+    return out
+
+
+def auto_retry_overflow(attempt: Callable[..., Tuple], caps: Dict,
+                        max_attempts: int = 6, grow: float = 2.0):
+    """Run `attempt(**caps)` until its overflow flag (last element of the
+    result tuple) clears, growing every capacity geometrically.
+
+    Returns (result_tuple, final_caps). The overflow check is a host sync —
+    this is a driver-level loop by design, like the plugin's catch-retry."""
+    for i in range(max_attempts):
+        out = attempt(**caps)
+        if not bool(jnp.any(out[-1])):
+            return out, caps
+        if i + 1 < max_attempts:
+            caps = _grown(caps, grow)
+    raise CapacityOverflowError(
+        f"overflow persisted after {max_attempts} attempts; final caps {caps}")
+
+
+def distributed_groupby_auto(mesh, keys, vals, aggs, key_cap: int,
+                             axis: str = "data", max_attempts: int = 6):
+    """distributed_groupby that retries with a doubled key_cap on overflow
+    (more distinct keys per shard than the static shape allowed)."""
+    out, _ = auto_retry_overflow(
+        lambda key_cap: distributed_groupby(mesh, keys, vals, aggs,
+                                            key_cap=key_cap, axis=axis),
+        {"key_cap": key_cap}, max_attempts)
+    return out
+
+
+def distributed_groupby_keyed_auto(mesh, key_words, key_specs, vals, aggs,
+                                   key_cap: int, axis: str = "data",
+                                   max_attempts: int = 6):
+    out, _ = auto_retry_overflow(
+        lambda key_cap: distributed_groupby_keyed(
+            mesh, key_words, key_specs, vals, aggs, key_cap=key_cap,
+            axis=axis),
+        {"key_cap": key_cap}, max_attempts)
+    return out
+
+
+def distributed_inner_join_auto(mesh, lkeys, lvals, rkeys, rvals,
+                                row_cap: int, slack: float = 2.0,
+                                axis: str = "data", max_attempts: int = 6):
+    """distributed_inner_join that grows BOTH capacities on overflow: the
+    merged flag covers bucket spill during the shuffle (fix: slack) and
+    join-output spill past row_cap (fix: row_cap); growing both converges
+    on skew of either kind."""
+    out, _ = auto_retry_overflow(
+        lambda row_cap, slack: distributed_inner_join(
+            mesh, lkeys, lvals, rkeys, rvals, row_cap=row_cap, slack=slack,
+            axis=axis),
+        {"row_cap": row_cap, "slack": slack}, max_attempts)
+    return out
+
+
+def distributed_inner_join_keyed_auto(mesh, l_words, lvals, r_words, rvals,
+                                      key_specs, row_cap: int,
+                                      slack: float = 2.0, axis: str = "data",
+                                      max_attempts: int = 6):
+    out, _ = auto_retry_overflow(
+        lambda row_cap, slack: distributed_inner_join_keyed(
+            mesh, l_words, lvals, r_words, rvals, key_specs,
+            row_cap=row_cap, slack=slack, axis=axis),
+        {"row_cap": row_cap, "slack": slack}, max_attempts)
+    return out
+
+
+def distributed_left_join_auto(mesh, lkeys, lvals, rkeys, rvals,
+                               row_cap: int, slack: float = 2.0,
+                               axis: str = "data", max_attempts: int = 6):
+    out, _ = auto_retry_overflow(
+        lambda row_cap, slack: distributed_left_join(
+            mesh, lkeys, lvals, rkeys, rvals, row_cap=row_cap, slack=slack,
+            axis=axis),
+        {"row_cap": row_cap, "slack": slack}, max_attempts)
+    return out
+
+
+def distributed_sort_auto(mesh, keys, vals, slack: float = 2.0,
+                          axis: str = "data", max_attempts: int = 6):
+    """distributed_sort that grows slack on overflow (key skew past the
+    sample-sort's balance estimate)."""
+    out, _ = auto_retry_overflow(
+        lambda slack: distributed_sort(mesh, keys, vals, slack=slack,
+                                       axis=axis),
+        {"slack": slack}, max_attempts)
+    return out
