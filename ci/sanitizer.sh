@@ -23,6 +23,13 @@ t2 = pa.table({
     "st": pa.array([{"a": 1, "b": "x"}, None] * 150,
                    pa.struct([("a", pa.int64()), ("b", pa.string())])),
     "dl": pa.array(list(range(300))),
+    # generalized nesting (kind-4 decode paths under ASan)
+    "mp": pa.array([[("k", 1)], None, []] * 100,
+                   pa.map_(pa.string(), pa.int64())),
+    "ls": pa.array([[{"x": 1}], None, []] * 100,
+                   pa.list_(pa.struct([("x", pa.int64())]))),
+    "sl": pa.array([{"v": [1, 2]}, None] * 150,
+                   pa.struct([("v", pa.list_(pa.int64()))])),
 })
 pq.write_table(t2, "$OUT/nested.parquet", row_group_size=128,
                use_dictionary=False, data_page_version="2.0",

@@ -29,9 +29,24 @@ from typing import List, Optional, Sequence, Union
 
 import numpy as np
 
+from collections import OrderedDict
+from typing import NamedTuple
+
 from .. import dtypes
 from ..columnar import Column, Table
 from ..native.build import build
+
+
+class _Node(NamedTuple):
+    """One generalized-ancestry node (kind-4 leaves): the Python image of
+    the native 4-int descriptor records. MAP records are expanded at parse
+    time into (list, implicit struct) so the builder only ever sees
+    'struct' and 'list' — a map IS LIST<STRUCT<key,value>> in this engine
+    (the same representation ops/map_utils.py produces)."""
+    kind: str      # "struct" | "list"
+    a: int         # struct: def of the group if optional else -1; list: dar
+    b: int         # list: def of the (optional) LIST group else -1
+    segs: int      # dotted path segments this node consumes
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -87,6 +102,17 @@ def _native():
                     ctypes.POINTER(ctypes.c_int64),
                     ctypes.c_void_p, ctypes.c_void_p,
                     ctypes.POINTER(ctypes.c_int64)]
+                lib.pqr_leaf_ancestry.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int32,
+                    ctypes.POINTER(ctypes.c_int32),
+                    ctypes.POINTER(ctypes.c_int32),
+                    ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+                lib.pqr_read_nested_column.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+                    ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.POINTER(ctypes.c_int64),
+                    ctypes.POINTER(ctypes.c_int64)]
                 lib.pqr_free.argtypes = [ctypes.c_void_p]
                 _lib = lib
     return _lib
@@ -105,6 +131,8 @@ class _Leaf:
         self.ancestor_defs = tuple(ancestor_defs)  # per ancestor group,
                                                    # -1 = required
         self.max_def = max_def
+        self.max_rep = 0
+        self.nodes = ()        # kind-4 generalized ancestry (_Node records)
         # LIST leaves carry the 3-level dotted path (f.list.element) and
         # STRUCT members their field path; the user-facing column name is
         # the outer field
@@ -172,6 +200,10 @@ class ParquetChunkedReader:
         if not self._h:
             raise ValueError(self._lib.pqr_last_error().decode())
         self._leaves = self._read_schema()
+        # top-level fields that assemble via the generalized nested builder
+        # (any kind-4 leaf pulls its whole display group through it)
+        self._nested_displays = {l.display for l in self._leaves
+                                 if l.kind == 4}
         if columns is not None:
             wanted = set(columns)
             present = {l.display for l in self._leaves}
@@ -199,6 +231,8 @@ class ParquetChunkedReader:
             phys, tl, conv, scale, prec, opt, flat = (x.value for x in ints)
             kind = self._lib.pqr_leaf_kind(self._h, i)
             anc, max_def = (), 0
+            nodes, max_rep = (), 0
+            anc_overflow = False
             if kind == 2:
                 md = ctypes.c_int32()
                 buf_anc = (ctypes.c_int32 * 16)()
@@ -208,16 +242,58 @@ class ParquetChunkedReader:
                     kind = 3            # too deep / inconsistent: skip
                 else:
                     anc, max_def = tuple(buf_anc[:n_anc]), md.value
+            if kind in (2, 4):
+                # kind-2 leaves need the generalized descriptor too: a mixed
+                # top-level field (STRUCT with both plain and list-bearing
+                # members) assembles every member through the nested builder
+                md, mr = ctypes.c_int32(), ctypes.c_int32()
+                buf_desc = (ctypes.c_int32 * 64)()
+                n_ints = self._lib.pqr_leaf_ancestry(
+                    self._h, i, ctypes.byref(md), ctypes.byref(mr),
+                    buf_desc, 64)
+                if n_ints < 0 or n_ints > 64 or n_ints % 4 != 0:
+                    if kind == 4:
+                        kind = 3
+                    else:
+                        # a kind-2 member without a descriptor cannot join a
+                        # mixed nested group: poison the field below rather
+                        # than crash the builder mid-tree
+                        anc_overflow = True
+                else:
+                    max_def, max_rep = md.value, mr.value
+                    parsed = []
+                    for k in range(n_ints // 4):
+                        t, a, b, segs = (buf_desc[4 * k], buf_desc[4 * k + 1],
+                                         buf_desc[4 * k + 2],
+                                         buf_desc[4 * k + 3])
+                        if t == 2:      # MAP -> list + implicit element struct
+                            parsed.append(_Node("list", a, b, segs))
+                            parsed.append(_Node("struct", -1, -1, 0))
+                        else:
+                            parsed.append(_Node("struct" if t == 0 else "list",
+                                                a, b, segs))
+                    nodes = tuple(parsed)
             leaf = _Leaf(i, buf.value.decode(), phys, tl, conv, scale,
                          prec, bool(opt), bool(flat), kind == 1,
                          kind == 2, anc, max_def)
             leaf.kind = kind
+            leaf.anc_overflow = anc_overflow
+            if kind in (2, 4):
+                leaf.nodes = nodes
+                leaf.max_rep = max_rep
+            if kind == 4:
+                leaf.display = leaf.name.split(".")[0]
             out.append(leaf)
         # an unsupported leaf poisons its whole top-level field: surfacing a
         # struct with silently missing members would misrepresent the schema
         bad = {l.name.split(".")[0] for l in out if l.kind == 3}
+        # a kind-2 member without an ancestry descriptor cannot assemble
+        # inside a mixed nested field — poison that field too
+        nested4 = {l.display for l in out if l.kind == 4}
+        bad |= {l.display for l in out
+                if l.anc_overflow and l.display in nested4}
         return [l for l in out
-                if (l.flat or l.is_list or l.is_struct_member)
+                if (l.flat or l.is_list or l.is_struct_member or l.kind == 4)
                 and l.display not in bad]
 
     @property
@@ -262,6 +338,21 @@ class ParquetChunkedReader:
     def _empty_columns(self) -> List[Column]:
         cols, done = [], set()
         for leaf in self._leaves:
+            if leaf.kind == 4 or leaf.display in self._nested_displays:
+                if leaf.display not in done:
+                    done.add(leaf.display)
+                    group = [l for l in self._leaves
+                             if l.display == leaf.display]
+                    decoded = [_NLeaf(l, l.name.split("."),
+                                      np.zeros(0, np.uint8),
+                                      np.zeros(0, np.int32),
+                                      np.zeros(0, np.int16),
+                                      np.zeros(0, np.int16), 0)
+                               for l in group]
+                    cols.append(_build_nested(
+                        decoded, 0, 0,
+                        [np.zeros(0, np.int64)] * len(group), 0))
+                continue
             if leaf.is_struct_member:
                 if leaf.display not in done:
                     done.add(leaf.display)
@@ -279,6 +370,16 @@ class ParquetChunkedReader:
         cols = []
         done_structs = set()
         for leaf in self._leaves:
+            if leaf.kind == 4 or leaf.display in self._nested_displays:
+                # generalized nesting: assemble the whole top-level field
+                # (a mixed struct pulls its plain members through this path
+                # too, so every member shares one slot-stream model)
+                if leaf.display not in done_structs:
+                    done_structs.add(leaf.display)
+                    group = [l for l in self._leaves
+                             if l.display == leaf.display]
+                    cols.append(self._read_nested_chunk(rg, group, n_rows))
+                continue
             if leaf.is_struct_member:
                 if leaf.display not in done_structs:
                     done_structs.add(leaf.display)
@@ -353,6 +454,86 @@ class ParquetChunkedReader:
                             n_rows, present.value)
             decoded.append((leaf, col, defs[:n_rows]))
         return _build_struct_tree(decoded, level=1, n_rows=n_rows)
+
+    def _read_nested_buffers(self, rg: int, leaf: _Leaf, n_rows: int):
+        """(values, lengths, defs, reps, present) for one leaf of a nested
+        field. Kind-4 leaves export raw level streams; kind-2 members of a
+        mixed struct synthesize reps == 0 over n_rows slots so both plug
+        into the same Dremel builder."""
+        if leaf.kind == 4:
+            nbytes = ctypes.c_int64()
+            present = ctypes.c_int64()
+            slots = ctypes.c_int64()
+
+            def call(values, lengths, defs, reps):
+                return self._lib.pqr_read_nested_column(
+                    self._h, rg, leaf.idx, values, ctypes.byref(nbytes),
+                    lengths, defs, reps, ctypes.byref(slots),
+                    ctypes.byref(present))
+
+            if call(None, None, None, None) != 0:
+                raise ValueError(self._lib.pqr_last_error().decode())
+            values = np.zeros(max(nbytes.value, 1), np.uint8)
+            lengths = np.zeros(max(present.value, 1), np.int32)
+            defs = np.zeros(max(slots.value, 1), np.uint8)
+            reps = np.zeros(max(slots.value, 1), np.uint8)
+            if call(values.ctypes.data_as(ctypes.c_void_p),
+                    lengths.ctypes.data_as(ctypes.c_void_p),
+                    defs.ctypes.data_as(ctypes.c_void_p),
+                    reps.ctypes.data_as(ctypes.c_void_p)) != 0:
+                raise ValueError(self._lib.pqr_last_error().decode())
+            s = slots.value
+            return (values[:nbytes.value], lengths[:present.value],
+                    defs[:s].astype(np.int16), reps[:s].astype(np.int16),
+                    int(present.value))
+        # kind-2 member: dense read + raw def levels, reps all zero
+        nbytes = ctypes.c_int64()
+        present = ctypes.c_int64()
+        rc = self._lib.pqr_read_column(self._h, rg, leaf.idx, None,
+                                       ctypes.byref(nbytes), None, None,
+                                       ctypes.byref(present))
+        if rc != 0:
+            raise ValueError(self._lib.pqr_last_error().decode())
+        defs = np.full(max(n_rows, 1), leaf.max_def, np.int16)
+        if leaf.max_def > 0:
+            d8 = np.zeros(max(n_rows, 1), np.uint8)
+            rc = self._lib.pqr_read_def_levels(
+                self._h, rg, leaf.idx, d8.ctypes.data_as(ctypes.c_void_p))
+            if rc != 0:
+                raise ValueError(self._lib.pqr_last_error().decode())
+            defs = d8.astype(np.int16)
+        values = np.zeros(max(nbytes.value, 1), np.uint8)
+        lengths = np.zeros(max(present.value, 1), np.int32)
+        defined = np.zeros(max(n_rows, 1), np.uint8)
+        rc = self._lib.pqr_read_column(
+            self._h, rg, leaf.idx,
+            values.ctypes.data_as(ctypes.c_void_p), ctypes.byref(nbytes),
+            lengths.ctypes.data_as(ctypes.c_void_p),
+            defined.ctypes.data_as(ctypes.c_void_p), ctypes.byref(present))
+        if rc != 0:
+            raise ValueError(self._lib.pqr_last_error().decode())
+        return (values[:nbytes.value], lengths[:present.value],
+                defs[:n_rows], np.zeros(n_rows, np.int16),
+                int(present.value))
+
+    def _read_nested_chunk(self, rg: int, group: List[_Leaf],
+                           n_rows: int) -> Column:
+        """Assemble one generalized-nested top-level field: read every
+        leaf's dense values + (def, rep) streams, then run the multi-level
+        Dremel reassembly (numpy, vectorized over level slots)."""
+        decoded = []
+        for leaf in group:
+            values, lengths, defs, reps, present = \
+                self._read_nested_buffers(rg, leaf, n_rows)
+            decoded.append(_NLeaf(leaf, leaf.name.split("."), values,
+                                  lengths, defs, reps, present))
+        ctxs = [np.nonzero(nl.reps == 0)[0] for nl in decoded]
+        for nl, ctx in zip(decoded, ctxs):
+            if len(ctx) != n_rows:
+                raise ValueError(
+                    f"nested column {nl.leaf.display!r}: row count mismatch "
+                    f"({len(ctx)} vs {n_rows})")
+        return _build_nested(decoded, 0, 0, ctxs, 0)
 
     def _read_list_chunk(self, rg: int, leaf: _Leaf, n_rows: int) -> Column:
         import jax.numpy as jnp
@@ -432,8 +613,10 @@ def _assemble(leaf: _Leaf, values: np.ndarray, lengths: np.ndarray,
     validity = None
     # struct members: a required member under an optional ancestor still has
     # undefined rows (the ancestor was null) — its child column must carry
-    # that validity so direct child consumers see nulls, like cudf
-    nullable = leaf.optional or getattr(leaf, "is_struct_member", False)
+    # that validity so direct child consumers see nulls, like cudf; kind-4
+    # elements likewise (null list/struct ancestors surface as def<max_def)
+    nullable = (leaf.optional or getattr(leaf, "is_struct_member", False)
+                or getattr(leaf, "kind", 0) == 4)
     if nullable and (defined == 0).any():
         validity = jnp.asarray(defined != 0)
 
@@ -514,6 +697,93 @@ def _build_struct_tree(decoded, level: int, n_rows: int) -> Column:
                       field_names=tuple(out_fields.keys()))
     return Column(dtype=dt, length=n_rows, validity=validity,
                   children=tuple(out_fields.values()))
+
+
+class _NLeaf(NamedTuple):
+    """One decoded leaf of a nested field: dense present values plus the
+    full (def, rep) level streams."""
+    leaf: "_Leaf"
+    parts: List[str]          # dotted path segments
+    values: np.ndarray
+    lengths: np.ndarray
+    defs: np.ndarray          # (slots,) int16
+    reps: np.ndarray          # (slots,) int16
+    present: int
+
+
+def _build_nested(group: List[_NLeaf], ni: int, si: int,
+                  ctxs: List[np.ndarray], depth: int) -> Column:
+    """Multi-level Dremel reassembly (numpy over level slots, not rows).
+
+    The classic level semantics: a slot with repetition level r continues
+    the depth-r list, so it starts a new element at every depth > r; an
+    element of the depth-k list exists iff rep <= k AND def >= dar_k (def
+    below dar_k is an empty/null list placeholder). Offsets at each depth
+    fall out of one boolean mask + np.add.reduceat over the parent entry
+    boundaries; struct/list validity is one def-threshold compare. This is
+    the whole reference cudf preprocess_levels pipeline as ~60 lines of
+    vectorized host code.
+
+    group: sibling leaves of one subtree (identical nodes[0..ni)).
+    ni/si: next ancestry node / next unconsumed path segment.
+    ctxs:  per-leaf slot indices of the current context entries (all the
+           same logical entries, one index array per leaf's own stream).
+    depth: repetition depth consumed so far (k of the next list = depth+1).
+    """
+    import jax.numpy as jnp
+    rep0 = group[0]
+    nodes = rep0.leaf.nodes
+    n_entries = len(ctxs[0])
+
+    if ni == len(nodes):
+        # element leaf
+        assert len(group) == 1, [nl.leaf.name for nl in group]
+        nl, ctx = group[0], ctxs[0]
+        defined = (nl.defs[ctx] == nl.leaf.max_def).astype(np.uint8)
+        return _assemble(nl.leaf, nl.values, nl.lengths, defined,
+                         n_entries, int(defined.sum()))
+
+    node = nodes[ni]
+    if node.kind == "struct":
+        validity = None
+        if node.a >= 0:
+            dv = rep0.defs[ctxs[0]] >= node.a
+            if not dv.all():
+                validity = jnp.asarray(dv)
+        fields: "OrderedDict[str, tuple]" = OrderedDict()
+        for nl, ctx in zip(group, ctxs):
+            key = nl.parts[si + node.segs]
+            fields.setdefault(key, ([], []))
+            fields[key][0].append(nl)
+            fields[key][1].append(ctx)
+        children = OrderedDict(
+            (k, _build_nested(nls, ni + 1, si + node.segs, cx, depth))
+            for k, (nls, cx) in fields.items())
+        dt = dtypes.DType(dtypes.Kind.STRUCT,
+                          children=tuple(c.dtype for c in children.values()),
+                          field_names=tuple(children.keys()))
+        return Column(dtype=dt, length=n_entries, validity=validity,
+                      children=tuple(children.values()))
+
+    # list node at repetition depth k
+    k = depth + 1
+    ctx0 = ctxs[0]
+    elem_mask = (rep0.reps <= k) & (rep0.defs >= node.a)
+    if n_entries:
+        counts = np.add.reduceat(elem_mask.astype(np.int32), ctx0)
+    else:
+        counts = np.zeros(0, np.int32)
+    offsets = np.zeros(n_entries + 1, np.int32)
+    np.cumsum(counts, out=offsets[1:])
+    validity = None
+    if node.b >= 0:
+        dv = rep0.defs[ctx0] >= node.b
+        if not dv.all():
+            validity = jnp.asarray(dv)
+    new_ctxs = [np.nonzero((nl.reps <= k) & (nl.defs >= node.a))[0]
+                for nl in group]
+    child = _build_nested(group, ni + 1, si + node.segs, new_ctxs, k)
+    return Column.make_list(jnp.asarray(offsets), child, validity)
 
 
 def _concat_tables(tables: List[Table]) -> Table:

@@ -10,9 +10,12 @@
 // Arrow-layout buffers (values + validity + offsets) ready for device_put.
 //
 // Scope: flat schemas, standard 3-level LIST<primitive> (Spark array
-// columns) and STRUCT<primitive> at any nesting depth (validity rebuilt
-// from raw def levels); MAP / LIST<STRUCT> / structs with unsupported
-// members are skipped whole, never mis-surfaced;
+// columns), STRUCT<primitive> at any nesting depth (validity rebuilt
+// from raw def levels), and generalized nesting — MAP, LIST<STRUCT>,
+// STRUCT<LIST>, LIST<LIST>, legacy 2-level lists — via kind-4 leaves that
+// export raw (def, rep) level streams for host-side Dremel reassembly
+// (io/parquet.py); truly exotic shapes are skipped whole, never
+// mis-surfaced;
 // PLAIN / RLE / PLAIN_DICTIONARY /
 // RLE_DICTIONARY / DELTA_BINARY_PACKED / DELTA_LENGTH_BYTE_ARRAY /
 // DELTA_BYTE_ARRAY / BYTE_STREAM_SPLIT encodings; DataPage v1+v2;
@@ -167,6 +170,16 @@ struct LeafSchema {
   // required (always valid)
   bool is_struct_member = false;
   std::vector<int32_t> ancestor_defs;
+  // generalized nested ancestry (MAP, LIST<STRUCT>, STRUCT<LIST>,
+  // LIST<LIST>, legacy 2-level lists): 4-int node records outermost first,
+  // [type, level_a, level_b, path_segments] where
+  //   type 0 STRUCT: level_a = def of the group if optional else -1
+  //   type 1 LIST:   level_a = def at the repeated node (dar),
+  //                  level_b = def of the (optional) LIST group else -1
+  //   type 2 MAP:    like LIST; the leaf path ends in key / value
+  // path_segments = how many dotted path segments the node consumes.
+  bool nested_ok = false;
+  std::vector<int32_t> anc_desc;
 };
 
 struct ChunkMeta {
@@ -298,6 +311,62 @@ void parse_schema(TReader& r, std::vector<LeafSchema>& leaves) {
         leaf.is_list = pe.repetition == 2 && pe.num_children == 1 &&
                        grand.depth == 1 && ge.num_children == 1 &&
                        ge.leaf.converted == 3 && ge.repetition != 2;
+      }
+      // Generalized ancestry (the kind-4 decode path): fold the group chain
+      // into STRUCT / LIST / MAP nodes per the parquet LogicalTypes
+      // backward-compat rules. Anything that doesn't fold stays kind 3.
+      {
+        std::vector<int32_t> desc;
+        bool ok = true;
+        size_t j = 1;
+        while (j < stack.size()) {
+          Frame const& fr = stack[j];
+          Elem const& E = elems[size_t(fr.elem_idx)];
+          bool const is_rep = E.repetition == 2;
+          bool const annot_list = E.leaf.converted == 3;
+          bool const annot_map = E.leaf.converted == 1 || E.leaf.converted == 2;
+          bool const next_rep =
+              j + 1 < stack.size() &&
+              elems[size_t(stack[j + 1].elem_idx)].repetition == 2;
+          if (!is_rep && annot_map && next_rep) {
+            // MAP group + repeated key_value group (2 children: key, value)
+            int32_t null_def = E.repetition == 1 ? fr.def_level : -1;
+            desc.insert(desc.end(),
+                        {2, stack[j + 1].def_level, null_def, 2});
+            j += 2;
+          } else if (!is_rep && annot_list && next_rep) {
+            Elem const& R = elems[size_t(stack[j + 1].elem_idx)];
+            int32_t null_def = E.repetition == 1 ? fr.def_level : -1;
+            desc.insert(desc.end(),
+                        {1, stack[j + 1].def_level, null_def, 2});
+            j += 2;
+            if (R.num_children > 1) {
+              // legacy: the repeated group IS the element struct — members
+              // hang directly off it (no extra path segment, never null)
+              desc.insert(desc.end(), {0, -1, -1, 0});
+            }
+          } else if (is_rep) {
+            // bare repeated group (legacy 2-level list); the group is the
+            // element when it has several children
+            desc.insert(desc.end(), {1, fr.def_level, -1, 1});
+            if (E.num_children > 1) desc.insert(desc.end(), {0, -1, -1, 0});
+            j += 1;
+          } else if (!annot_list && !annot_map) {
+            // plain struct group
+            int32_t opt = E.repetition == 1 ? fr.def_level : -1;
+            desc.insert(desc.end(), {0, opt, -1, 1});
+            j += 1;
+          } else {
+            ok = false;   // annotated group without its repeated child
+            break;
+          }
+        }
+        if (e.repetition == 2) {
+          // repeated primitive leaf: legacy 2-level LIST of the value
+          desc.insert(desc.end(), {1, def, -1, 0});
+        }
+        leaf.nested_ok = ok && rep >= 1 && rep <= 4 && !desc.empty();
+        leaf.anc_desc = std::move(desc);
       }
       leaves.push_back(std::move(leaf));
     }
@@ -650,6 +719,9 @@ struct DecodedChunk {
   std::vector<uint8_t> list_valid;   // per-row list validity
   // struct members only: raw definition level per row (<= max_def <= 255)
   std::vector<uint8_t> def_levels;
+  // generalized nested chunks (kind 4) only: raw repetition level per slot,
+  // aligned with def_levels; Python does the multi-level Dremel reassembly
+  std::vector<uint8_t> rep_levels;
 };
 
 inline int level_bit_width(int32_t max_level) {
@@ -965,6 +1037,22 @@ DecodedChunk decode_chunk(FileState const& st, ChunkMeta const& cm,
           if (def_full) present++;
         }
       }
+    } else if (leaf.nested_ok && !leaf.flat && !leaf.is_list &&
+               !leaf.is_struct_member) {
+      // kind-4 generalized nesting: export the raw (def, rep) streams and
+      // decode values densely; Python reassembles all levels (numpy Dremel)
+      if (defs.empty() || reps.empty())
+        throw std::runtime_error("parquet: nested page missing levels");
+      present = 0;
+      page_rows = 0;
+      for (int64_t i = 0; i < page_values; i++) {
+        if (reps[i] == 0) page_rows++;
+        bool const d = defs[i] == leaf.max_def;
+        out.defined.push_back(uint8_t(d));
+        out.def_levels.push_back(uint8_t(defs[i]));
+        out.rep_levels.push_back(uint8_t(reps[i]));
+        if (d) present++;
+      }
     } else if (!defs.empty()) {
       present = 0;
       // any optional ancestor or member needs the raw levels (max_def==1
@@ -1110,7 +1198,9 @@ std::shared_ptr<DecodedChunk> get_chunk(FileState* st, int32_t rg,
 }
 
 // 0 = flat primitive, 1 = LIST<primitive>, 2 = STRUCT member (primitive
-// under plain groups), 3 = unsupported shape
+// under plain groups), 3 = unsupported shape, 4 = generalized nesting
+// (MAP / LIST<STRUCT> / STRUCT<LIST> / LIST<LIST> / legacy 2-level lists,
+// decoded via pqr_read_nested_column + host-side Dremel reassembly)
 int32_t pqr_leaf_kind(void* h, int32_t i) {
   auto* st = static_cast<FileState*>(h);
   if (i < 0 || size_t(i) >= st->leaves.size()) return -1;
@@ -1118,7 +1208,61 @@ int32_t pqr_leaf_kind(void* h, int32_t i) {
   if (l.flat) return 0;
   if (l.is_list) return 1;
   if (l.is_struct_member) return 2;
+  if (l.nested_ok) return 4;
   return 3;
+}
+
+// The generalized ancestry descriptor (4-int node records, see LeafSchema)
+// plus the leaf's level bounds. Returns the int count, or -1 on error.
+int32_t pqr_leaf_ancestry(void* h, int32_t i, int32_t* max_def,
+                          int32_t* max_rep, int32_t* desc, int32_t cap) {
+  auto* st = static_cast<FileState*>(h);
+  if (i < 0 || size_t(i) >= st->leaves.size()) return -1;
+  auto const& l = st->leaves[i];
+  *max_def = l.max_def;
+  *max_rep = l.max_rep;
+  int32_t n = int32_t(l.anc_desc.size());
+  for (int32_t k = 0; k < n && k < cap; k++) desc[k] = l.anc_desc[k];
+  return n;
+}
+
+// Two-phase read of a generalized nested chunk (kind 4): sizing call
+// (values==nullptr) fills *values_nbytes, *num_present and *num_slots;
+// the fill call populates values (dense), lengths (strings; per present
+// value), def_levels and rep_levels (num_slots bytes each).
+int32_t pqr_read_nested_column(void* h, int32_t rg, int32_t leaf,
+                               uint8_t* values, int64_t* values_nbytes,
+                               int32_t* lengths, uint8_t* def_levels,
+                               uint8_t* rep_levels, int64_t* num_slots,
+                               int64_t* num_present) {
+  auto* st = static_cast<FileState*>(h);
+  try {
+    if (leaf < 0 || size_t(leaf) >= st->leaves.size())
+      throw std::runtime_error("leaf out of range");
+    auto const& lf = st->leaves[leaf];
+    if (!(lf.nested_ok && !lf.flat && !lf.is_list && !lf.is_struct_member))
+      throw std::runtime_error("not a generalized nested column");
+    auto dcp = get_chunk(st, rg, leaf, values != nullptr);
+    DecodedChunk const& dc = *dcp;
+    int64_t present = 0;
+    for (uint8_t d : dc.defined) present += d;
+    *values_nbytes = int64_t(dc.values.size());
+    *num_present = present;
+    *num_slots = int64_t(dc.def_levels.size());
+    if (!values) return 0;
+    std::memcpy(values, dc.values.data(), dc.values.size());
+    if (lengths && !dc.lengths.empty())
+      std::memcpy(lengths, dc.lengths.data(),
+                  dc.lengths.size() * sizeof(int32_t));
+    if (def_levels && !dc.def_levels.empty())
+      std::memcpy(def_levels, dc.def_levels.data(), dc.def_levels.size());
+    if (rep_levels && !dc.rep_levels.empty())
+      std::memcpy(rep_levels, dc.rep_levels.data(), dc.rep_levels.size());
+    return 0;
+  } catch (std::exception const& e) {
+    g_error = e.what();
+    return -1;
+  }
 }
 
 // ancestor def levels for a struct-member leaf, one per ancestor group

@@ -54,6 +54,13 @@ int64_t pqr_num_rows(void* h);
 int32_t pqr_num_row_groups(void* h);
 int32_t pqr_num_leaves(void* h);
 int32_t pqr_leaf_kind(void* h, int32_t i);
+int32_t pqr_leaf_ancestry(void* h, int32_t i, int32_t* max_def,
+                          int32_t* max_rep, int32_t* desc, int32_t cap);
+int32_t pqr_read_nested_column(void* h, int32_t rg, int32_t leaf,
+                               uint8_t* values, int64_t* values_nbytes,
+                               int32_t* lengths, uint8_t* def_levels,
+                               uint8_t* rep_levels, int64_t* num_slots,
+                               int64_t* num_present);
 int64_t pqr_row_group_num_rows(void* h, int32_t rg);
 int32_t pqr_read_list_column(void* h, int32_t rg, int32_t leaf,
                              uint8_t* values, int64_t* values_nbytes,
@@ -241,12 +248,41 @@ static void test_parquet_nested(char const* path) {
   void* h = pqr_open_ex(bytes.data(), int64_t(bytes.size()), 0);
   CHECK(h != nullptr);
   if (!h) { std::fprintf(stderr, "%s\n", pqr_last_error()); return; }
-  bool saw_list = false, saw_struct = false;
+  bool saw_list = false, saw_struct = false, saw_nested = false;
   for (int32_t leaf = 0; leaf < pqr_num_leaves(h); leaf++) {
     int32_t kind = pqr_leaf_kind(h, leaf);
     for (int32_t rg = 0; rg < pqr_num_row_groups(h); rg++) {
       size_t const rg_rows = size_t(pqr_row_group_num_rows(h, rg));
-      if (kind == 1) {
+      if (kind == 4) {
+        // generalized nesting (MAP / LIST<STRUCT> / STRUCT<LIST>): raw
+        // level streams + ancestry descriptor round-trip under ASan
+        saw_nested = true;
+        int32_t max_def = 0, max_rep = 0;
+        int32_t desc[64];
+        int32_t n_ints = pqr_leaf_ancestry(h, leaf, &max_def, &max_rep,
+                                           desc, 64);
+        CHECK(n_ints > 0 && n_ints % 4 == 0);
+        CHECK(max_rep >= 1 && max_def >= max_rep);
+        int64_t nbytes = 0, slots = 0, present = 0;
+        CHECK(pqr_read_nested_column(h, rg, leaf, nullptr, &nbytes, nullptr,
+                                     nullptr, nullptr, &slots,
+                                     &present) == 0);
+        std::vector<uint8_t> values(size_t(nbytes) + 1);
+        std::vector<int32_t> lengths(size_t(present) + 1);
+        std::vector<uint8_t> defs(size_t(slots) + 1);
+        std::vector<uint8_t> reps(size_t(slots) + 1);
+        CHECK(pqr_read_nested_column(h, rg, leaf, values.data(), &nbytes,
+                                     lengths.data(), defs.data(),
+                                     reps.data(), &slots, &present) == 0);
+        int64_t rows = 0, got_present = 0;
+        for (int64_t i = 0; i < slots; i++) {
+          CHECK(defs[size_t(i)] <= max_def && reps[size_t(i)] <= max_rep);
+          if (reps[size_t(i)] == 0) rows++;
+          if (defs[size_t(i)] == max_def) got_present++;
+        }
+        CHECK(rows == int64_t(rg_rows));
+        CHECK(got_present == present);
+      } else if (kind == 1) {
         saw_list = true;
         int64_t nbytes = 0, slots = 0, present = 0, rows = 0;
         CHECK(pqr_read_list_column(h, rg, leaf, nullptr, &nbytes, nullptr,
@@ -280,7 +316,9 @@ static void test_parquet_nested(char const* path) {
       }
     }
   }
-  CHECK(saw_list && saw_struct);
+  // the ci/sanitizer.sh fixture always carries kind-4 fields (mp/ls/sl):
+  // a schema-classification regression must fail loudly, not skip coverage
+  CHECK(saw_list && saw_struct && saw_nested);
   pqr_free(h);
 }
 
@@ -294,13 +332,24 @@ static void test_parquet_truncation_fuzz(char const* path) {
   }
   std::vector<uint8_t> bytes((std::istreambuf_iterator<char>(f)),
                              std::istreambuf_iterator<char>());
+  auto poke = [](void* h) {
+    // size every column through its kind's entry point — nested (kind 4)
+    // decode paths walk the raw level streams and must stay in-bounds on
+    // corrupt input too
+    int64_t nbytes = 0, present = 0, slots = 0;
+    for (int32_t leaf = 0; leaf < pqr_num_leaves(h) && leaf < 8; leaf++) {
+      if (pqr_leaf_kind(h, leaf) == 4)
+        pqr_read_nested_column(h, 0, leaf, nullptr, &nbytes, nullptr,
+                               nullptr, nullptr, &slots, &present);
+      else
+        pqr_read_column(h, 0, leaf, nullptr, &nbytes, nullptr, nullptr,
+                        &present);
+    }
+  };
   for (size_t cut = 0; cut < bytes.size(); cut += 97) {
     void* h = pqr_open_ex(bytes.data(), int64_t(cut), 1);
     if (h) {
-      int64_t nbytes = 0, present = 0;
-      for (int32_t leaf = 0; leaf < pqr_num_leaves(h) && leaf < 4; leaf++)
-        pqr_read_column(h, 0, leaf, nullptr, &nbytes, nullptr, nullptr,
-                        &present);
+      poke(h);
       pqr_free(h);
     }
   }
@@ -311,10 +360,7 @@ static void test_parquet_truncation_fuzz(char const* path) {
     mut[i] ^= 0x5A;
     void* h = pqr_open_ex(mut.data(), int64_t(mut.size()), 1);
     if (h) {
-      int64_t nbytes = 0, present = 0;
-      for (int32_t leaf = 0; leaf < pqr_num_leaves(h) && leaf < 4; leaf++)
-        pqr_read_column(h, 0, leaf, nullptr, &nbytes, nullptr, nullptr,
-                        &present);
+      poke(h);
       pqr_free(h);
     }
   }

@@ -1,0 +1,234 @@
+"""Generalized nested parquet decoding (round-2 mandate #7): MAP,
+LIST<STRUCT>, STRUCT<LIST>, LIST<LIST>, deep combinations and legacy
+2-level lists, verified by pyarrow round-trips (replacing round 1's
+skip-listing). Oracle: pyarrow's own reading of the same file."""
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import spark_rapids_tpu  # noqa: F401
+from spark_rapids_tpu.io import read_parquet
+
+
+def _roundtrip(table: pa.Table, **write_kwargs):
+    buf = io.BytesIO()
+    pq.write_table(table, buf, **write_kwargs)
+    return read_parquet(buf.getvalue())
+
+
+def _map_as_kvlist(rows):
+    """pyarrow map rows → the engine's LIST<STRUCT<key,value>> image."""
+    out = []
+    for r in rows:
+        if r is None:
+            out.append(None)
+        else:
+            out.append([{"key": k, "value": v} for k, v in r])
+    return out
+
+
+def test_map_with_nulls_and_empties():
+    rows = [[("a", 1), ("b", 2)], None, [], [("c", None)], [("d", 4)]]
+    t = pa.table({"m": pa.array(rows, pa.map_(pa.string(), pa.int64()))})
+    got = _roundtrip(t)
+    assert got["m"].to_pylist() == _map_as_kvlist(rows)
+
+
+def test_list_of_struct_all_member_types():
+    rows = [[{"x": 1, "y": "ab", "z": 1.5}, {"x": None, "y": None, "z": None}],
+            None, [],
+            [{"x": 3, "y": "日本", "z": -2.25}]]
+    t = pa.table({"ls": pa.array(rows, pa.list_(pa.struct(
+        [("x", pa.int64()), ("y", pa.string()), ("z", pa.float64())])))})
+    got = _roundtrip(t)
+    assert got["ls"].to_pylist() == rows
+
+
+def test_struct_of_list_and_plain_members():
+    rows = [{"v": [1, 2], "w": 9, "s": "p"}, None,
+            {"v": None, "w": 8, "s": None}, {"v": [], "w": None, "s": "q"}]
+    t = pa.table({"sl": pa.array(rows, pa.struct(
+        [("v", pa.list_(pa.int64())), ("w", pa.int64()), ("s", pa.string())]))})
+    got = _roundtrip(t)
+    assert got["sl"].to_pylist() == rows
+
+
+def test_list_of_list_of_strings():
+    rows = [[["a", "bb"], []], None, [None], [["ccc", None], ["d"]]]
+    t = pa.table({"ll": pa.array(rows, pa.list_(pa.list_(pa.string())))})
+    got = _roundtrip(t)
+    assert got["ll"].to_pylist() == rows
+
+
+def test_map_of_list_values():
+    rows = [[("a", [1, 2]), ("b", [])], None, [("c", None)], []]
+    t = pa.table({"mv": pa.array(rows,
+                                 pa.map_(pa.string(), pa.list_(pa.int64())))})
+    got = _roundtrip(t)
+    assert got["mv"].to_pylist() == _map_as_kvlist(rows)
+
+
+def test_struct_in_map_value():
+    rows = [[("k1", {"a": 1, "b": "x"})], None,
+            [("k2", None), ("k3", {"a": None, "b": "y"})]]
+    t = pa.table({"ms": pa.array(rows, pa.map_(
+        pa.string(), pa.struct([("a", pa.int64()), ("b", pa.string())])))})
+    got = _roundtrip(t)
+    assert got["ms"].to_pylist() == _map_as_kvlist(rows)
+
+
+def test_three_level_deep_nesting():
+    rows = [[{"tags": [["t1", "t2"], []], "n": 1}],
+            None,
+            [{"tags": None, "n": 2}, {"tags": [["t3"]], "n": None}]]
+    t = pa.table({"deep": pa.array(rows, pa.list_(pa.struct(
+        [("tags", pa.list_(pa.list_(pa.string()))), ("n", pa.int64())])))})
+    got = _roundtrip(t)
+    assert got["deep"].to_pylist() == rows
+
+
+def test_multiple_row_groups_and_dictionary():
+    rng = np.random.default_rng(0)
+    rows = []
+    for i in range(400):
+        if i % 17 == 0:
+            rows.append(None)
+        else:
+            rows.append([{"x": int(rng.integers(0, 5)),
+                          "y": ["v%d" % (i % 3)] * int(rng.integers(0, 3))}
+                         for _ in range(int(rng.integers(0, 4)))])
+    t = pa.table({"r": pa.array(rows, pa.list_(pa.struct(
+        [("x", pa.int64()), ("y", pa.list_(pa.string()))])))})
+    got = _roundtrip(t, row_group_size=64)
+    assert got["r"].to_pylist() == rows
+
+
+def test_nested_alongside_flat_and_empty_selection():
+    rows = [[("a", 1)], None]
+    t = pa.table({
+        "m": pa.array(rows, pa.map_(pa.string(), pa.int64())),
+        "plain": pa.array([7, 8]),
+    })
+    got = _roundtrip(t)
+    assert got["plain"].to_pylist() == [7, 8]
+    assert got["m"].to_pylist() == _map_as_kvlist(rows)
+
+
+def _legacy_two_level_file() -> bytes:
+    """Hand-assemble a minimal legacy parquet file: one column whose schema
+    is `repeated int32 nums` directly (2-level list — no LIST annotation,
+    no inner element group), the shape pre-2.x writers produced. pyarrow
+    cannot write it, so the bytes are built by hand: PLAIN data page v1
+    with bit-packed/RLE rep levels, thrift-compact footer."""
+    import struct
+
+    def uleb(n):
+        out = b""
+        while True:
+            b7 = n & 0x7F
+            n >>= 7
+            out += bytes([b7 | (0x80 if n else 0)])
+            if not n:
+                return out
+
+    def zz(n):
+        return uleb((n << 1) ^ (n >> 63))
+
+    # rows: [1,2], [], [3]  → values 1,2,3
+    # slots: (def,rep): (1,0) (1,1) (0,0) (1,0); max_def=1 max_rep=1
+    # levels as one bit-packed RLE group: header = (num_groups << 1) | 1
+    # with num_groups=1 → 0x03; byte = bits little-endian per value:
+    # d=[1,1,0,1,...] → 0b00001011 = 0x0B
+    defs_payload = bytes([0x03, 0x0B])
+    reps_payload = bytes([0x03, 0x02])   # r=[0,1,0,0,...] → 0b00000010
+    values = struct.pack("<iii", 1, 2, 3)
+    page_data = (struct.pack("<I", len(reps_payload)) + reps_payload +
+                 struct.pack("<I", len(defs_payload)) + defs_payload +
+                 values)
+
+    # thrift compact PageHeader (DataPage v1):
+    #  1: type(i32)=0, 2: uncompressed_size, 3: compressed_size,
+    #  5: data_page_header { 1: num_values=4, 2: encoding=0 PLAIN,
+    #     3: def_enc=3 RLE, 4: rep_enc=3 RLE }
+    def fld(prev, fid, tp):
+        d = fid - prev
+        assert 0 < d <= 15
+        return bytes([(d << 4) | tp])
+
+    ph = b""
+    ph += fld(0, 1, 5) + zz(0)
+    ph += fld(1, 2, 5) + zz(len(page_data))
+    ph += fld(2, 3, 5) + zz(len(page_data))
+    dph = (fld(0, 1, 5) + zz(4) + fld(1, 2, 5) + zz(0) +
+           fld(2, 3, 5) + zz(3) + fld(3, 4, 5) + zz(3) + b"\x00")
+    ph += fld(3, 5, 12) + dph + b"\x00"
+
+    body = b"PAR1" + ph + page_data
+    data_offset = 4  # page header starts right after magic
+
+    # footer FileMetaData:
+    #  1: version=1, 2: schema list<SchemaElement>, 3: num_rows=3,
+    #  4: row_groups
+    def schema_elem(fields: bytes) -> bytes:
+        return fields + b"\x00"
+
+    # root: 4: num_children=1, 5: name? — SchemaElement fields:
+    #  1: type, 2: type_length, 3: repetition_type, 4: name, 5: num_children,
+    #  6: converted_type
+    def selem(name, typ=None, repetition=None, num_children=None):
+        out = b""
+        prev = 0
+        if typ is not None:
+            out += fld(prev, 1, 5) + zz(typ)
+            prev = 1
+        if repetition is not None:
+            out += fld(prev, 3, 5) + zz(repetition)
+            prev = 3
+        out += fld(prev, 4, 8) + uleb(len(name)) + name.encode()
+        prev = 4
+        if num_children is not None:
+            out += fld(prev, 5, 5) + zz(num_children)
+            prev = 5
+        return out + b"\x00"
+
+    schema = [selem("root", num_children=1),
+              selem("nums", typ=1, repetition=2)]       # repeated INT32
+    schema_list = bytes([(len(schema) << 4) | 12]) + b"".join(schema)
+
+    # ColumnMetaData: 1: type=1, 2: encodings [0,3], 3: path ["nums"],
+    # 4: codec=0, 5: num_values=4, 6: total_uncompressed_size,
+    # 7: total_compressed_size, 9: data_page_offset
+    cmd = b""
+    cmd += fld(0, 1, 5) + zz(1)
+    cmd += fld(1, 2, 9) + bytes([(2 << 4) | 5]) + zz(0) + zz(3)
+    cmd += fld(2, 3, 9) + bytes([(1 << 4) | 8]) + uleb(4) + b"nums"
+    cmd += fld(3, 4, 5) + zz(0)
+    cmd += fld(4, 5, 6) + zz(4)                       # num_values: i64
+    cmd += fld(5, 6, 6) + zz(len(page_data) + len(ph))
+    cmd += fld(6, 7, 6) + zz(len(page_data) + len(ph))
+    cmd += fld(7, 9, 6) + zz(data_offset)             # data_page_offset: i64
+    cmd += b"\x00"
+    # ColumnChunk: 2: file_offset (i64), 3: meta_data
+    cc = fld(0, 2, 6) + zz(data_offset) + fld(2, 3, 12) + cmd + b"\x00"
+    # RowGroup: 1: columns, 2: total_byte_size (i64), 3: num_rows (i64)
+    rg = (fld(0, 1, 9) + bytes([(1 << 4) | 12]) + cc +
+          fld(1, 2, 6) + zz(len(page_data)) + fld(2, 3, 6) + zz(3) + b"\x00")
+    fmeta = (fld(0, 1, 5) + zz(1) +
+             fld(1, 2, 9) + schema_list +
+             fld(2, 3, 6) + zz(3) +                   # num_rows: i64
+             fld(3, 4, 9) + bytes([(1 << 4) | 12]) + rg + b"\x00")
+    footer = fmeta
+    out = body + footer + struct.pack("<I", len(footer)) + b"PAR1"
+    return out
+
+
+def test_legacy_two_level_repeated_primitive():
+    data = _legacy_two_level_file()
+    # sanity: pyarrow agrees this is a list column with our expected rows
+    oracle = pq.read_table(io.BytesIO(data))
+    assert oracle["nums"].to_pylist() == [[1, 2], [], [3]]
+    got = read_parquet(data)
+    assert got["nums"].to_pylist() == [[1, 2], [], [3]]

@@ -287,9 +287,11 @@ class TestListColumns:
         assert got["l"].to_pylist() == [[1], [2, 3], []]
 
 
-def test_map_and_nested_struct_shapes_excluded_not_corrupted(tmp_path):
-    """MAP, LIST<STRUCT> and STRUCT<LIST> leaves must be skipped entirely —
-    a loose is_list test would surface them as wrong columns."""
+def test_map_and_nested_struct_shapes_decode(tmp_path):
+    """MAP, LIST<STRUCT> and STRUCT<LIST> decode through the generalized
+    Dremel path (round-2: these were skip-listed in round 1). Maps surface
+    as LIST<STRUCT<key,value>> — the engine's map representation
+    (ops/map_utils.py produces the same shape)."""
     t = pa.table({
         "m": pa.array([{"a": 1}, {"b": 2}], pa.map_(pa.utf8(), pa.int64())),
         "lstruct": pa.array([[{"x": 1}], []],
@@ -302,7 +304,11 @@ def test_map_and_nested_struct_shapes_excluded_not_corrupted(tmp_path):
     path = str(tmp_path / "mixed.parquet")
     pq.write_table(t, path)
     got = read_parquet(path)
-    assert list(got.names) == ["ok", "larr"]
+    assert list(got.names) == ["m", "lstruct", "slist", "ok", "larr"]
+    assert got["m"].to_pylist() == [[{"key": "a", "value": 1}],
+                                    [{"key": "b", "value": 2}]]
+    assert got["lstruct"].to_pylist() == [[{"x": 1}], []]
+    assert got["slist"].to_pylist() == [{"v": [1, 2]}, {"v": []}]
     assert got["ok"].to_pylist() == [10, 20]
     assert got["larr"].to_pylist() == [[1, 2], [3]]
 
@@ -371,9 +377,10 @@ def test_optional_struct_all_required_members(tmp_path):
     assert got["s"].children[0].to_pylist() == [1, None, 3]
 
 
-def test_struct_with_unsupported_member_dropped_whole(tmp_path):
-    """struct<x:int64, v:list<int64>>: surfacing it without v would
-    misrepresent the schema — drop the whole field."""
+def test_struct_with_mixed_members_decodes_whole(tmp_path):
+    """struct<x:int64, v:list<int64>>: the plain member and the
+    list-bearing member assemble through one slot-stream model (round 1
+    dropped the whole field)."""
     t = pa.table({
         "s": pa.array([{"x": 1, "v": [1, 2]}],
                       pa.struct([("x", pa.int64()),
@@ -383,4 +390,6 @@ def test_struct_with_unsupported_member_dropped_whole(tmp_path):
     path = str(tmp_path / "partial.parquet")
     pq.write_table(t, path)
     got = read_parquet(path)
-    assert list(got.names) == ["ok"]
+    assert list(got.names) == ["s", "ok"]
+    assert got["s"].to_pylist() == [{"x": 1, "v": [1, 2]}]
+    assert got["ok"].to_pylist() == [5]
