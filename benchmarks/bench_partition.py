@@ -1,0 +1,51 @@
+"""Shuffle bucket-partition bench: A/B of the three histogram/rank paths.
+
+Round-1 chip measurements flagged `searchsorted` (~2 s @ 10M rows) and
+emulated scatter-add (~930 ms) — both sit in the sort-based
+`build_partition_map`. Contenders:
+
+  sort:   argsort + 2x searchsorted (parallel/shuffle.py, round-1 path)
+  scan:   streaming compare-reduce ranks, no sort/searchsorted/scatter-add
+          (parallel/partition.py)
+  pallas: explicit-kernel histogram, counts resident in VMEM across the
+          grid (parallel/partition_pallas.py; histogram only)
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from benchmarks.common import parse_args, run_config  # noqa: E402
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu.parallel.partition import (build_partition_map_scan,
+                                                     partition_histogram)
+    from spark_rapids_tpu.parallel.partition_pallas import histogram_pallas
+    from spark_rapids_tpu.parallel.shuffle import build_partition_map
+
+    rng = np.random.default_rng(0)
+    n_rows = max(int(10_000_000 * args.scale), 4096)
+    for P in (8, 64):
+        cap = (n_rows // P) * 2
+        part = jnp.asarray(rng.integers(0, P, n_rows).astype(np.int32))
+        run_config("partition_map_sort", {"num_rows": n_rows, "P": P},
+                   lambda p: build_partition_map(p, P, cap), (part,),
+                   n_rows=n_rows, iters=args.iters)
+        run_config("partition_map_scan", {"num_rows": n_rows, "P": P},
+                   lambda p: build_partition_map_scan(p, P, cap), (part,),
+                   n_rows=n_rows, iters=args.iters)
+        run_config("histogram_scan", {"num_rows": n_rows, "P": P},
+                   lambda p: partition_histogram(p, P), (part,),
+                   n_rows=n_rows, iters=args.iters)
+        interpret = jax.default_backend() != "tpu"
+        run_config("histogram_pallas", {"num_rows": n_rows, "P": P},
+                   lambda p: histogram_pallas(p, P, interpret=interpret),
+                   (part,), n_rows=n_rows, iters=args.iters, jit=False)
+
+
+if __name__ == "__main__":
+    main()
