@@ -17,6 +17,9 @@ _SOURCES = {
     "resource_adaptor": ["resource_adaptor.cpp"],
     "parquet_footer": ["parquet_footer.cpp"],
     "parquet_reader": ["parquet_reader.cpp"],
+    # standalone Arrow C Data Interface consumer: proves the export_to_c
+    # binding surface is consumable by a non-Python runtime (zero-copy)
+    "arrow_c_consumer": ["arrow_c_consumer.cpp"],
 }
 
 # extra link flags per lib (page decompression codecs; libsnappy ships no
