@@ -145,12 +145,29 @@ def _f64_from_bits(bits: jnp.ndarray) -> jnp.ndarray:
 
 
 def encode_key_column(col: Column,
-                      max_bytes: Optional[int] = None
+                      max_bytes: Optional[int] = None,
+                      spec: Optional[KeySpec] = None
                       ) -> Tuple[List[jnp.ndarray], KeySpec]:
-    """Encode one key column into its int64 word list + static spec."""
+    """Encode one key column into its int64 word list + static spec.
+
+    Pass `spec` (e.g. the other join side's) to force the layout: a
+    non-null column encoded under a nullable spec gets an all-valid flag
+    word, so both sides of a join produce identical word counts even when
+    only one side carries nulls."""
     k = col.dtype.kind
     valid = col.null_mask
     nullable = col.validity is not None
+    if spec is not None:
+        if spec.dtype.kind != k:
+            raise TypeError(f"spec dtype {spec.dtype} != column {col.dtype}")
+        if nullable and not spec.nullable:
+            raise ValueError(
+                "column has nulls but the target spec is non-nullable; "
+                "encode the nullable side first (its specs then force the "
+                "flag word on the other side)")
+        nullable = spec.nullable
+        if k == Kind.STRING:
+            max_bytes = spec.max_bytes
     words: List[jnp.ndarray] = []
 
     if k in _ONE_WORD_KINDS:
@@ -188,18 +205,28 @@ def encode_key_column(col: Column,
 
 
 def encode_key_columns(cols: Sequence[Column],
-                       max_bytes: Union[None, int, Sequence[Optional[int]]] = None
+                       max_bytes: Union[None, int, Sequence[Optional[int]]] = None,
+                       specs: Optional[Sequence[KeySpec]] = None
                        ) -> Tuple[List[jnp.ndarray], List[KeySpec]]:
-    """Encode several key columns; returns the flat word list + specs."""
+    """Encode several key columns; returns the flat word list + specs.
+
+    For joins, encode one side first and pass its `specs` when encoding
+    the other so both sides share one static layout:
+
+        lw, specs = encode_key_columns(lcols, max_bytes=16)
+        rw, _     = encode_key_columns(rcols, specs=specs)
+    """
     if max_bytes is None or isinstance(max_bytes, int):
         max_bytes = [max_bytes] * len(cols)
+    if specs is None:
+        specs = [None] * len(cols)
     words: List[jnp.ndarray] = []
-    specs: List[KeySpec] = []
-    for c, mb in zip(cols, max_bytes):
-        w, s = encode_key_column(c, mb)
+    out_specs: List[KeySpec] = []
+    for c, mb, sp in zip(cols, max_bytes, specs):
+        w, s = encode_key_column(c, mb, spec=sp)
         words.extend(w)
-        specs.append(s)
-    return words, specs
+        out_specs.append(s)
+    return words, out_specs
 
 
 def decode_key_columns(words: Sequence[jnp.ndarray], specs: Sequence[KeySpec],
@@ -259,6 +286,26 @@ def _unpack_string_words(wordlist: Sequence[jnp.ndarray],
             cols8.append(((u >> jnp.uint64(shift)) &
                           jnp.uint64(0xFF)).astype(jnp.uint8))
     return jnp.stack(cols8, axis=1)[:, :M]
+
+
+def keys_null_mask(words: Sequence[jnp.ndarray],
+                   specs: Sequence[KeySpec]) -> jnp.ndarray:
+    """(n,) bool, True where ANY key column is null. Equi-join semantics:
+    a NULL key never matches (Spark `l.k = r.k` is never true on NULL), so
+    the keyed joins exclude these rows from matching — unlike groupby,
+    where nulls form one group. Dead exchange slots carry non-zero
+    sentinel words and read as not-null; they are excluded by the alive
+    masks instead."""
+    null = None
+    i = 0
+    for spec in specs:
+        if spec.nullable:
+            col_null = words[i] == 0
+            null = col_null if null is None else (null | col_null)
+        i += spec.total_words
+    if null is None:
+        return jnp.zeros(words[0].shape, jnp.bool_)
+    return null
 
 
 def spark_partition_hash(words: Sequence[jnp.ndarray],

@@ -326,17 +326,22 @@ def _as_list(x):
 
 
 def _local_join_tail(lk, lv, lalive, rk, rv, ralive, row_cap: int,
-                     outer: bool = False):
+                     outer: bool = False, lmatch=None, rmatch=None):
     """Shard-local (inner or left-outer) join into a fixed row_cap: union
     rank + sort-merge spans + padded expansion (ops/join.py machinery on
     shard-local shapes). Key sides may be single arrays or word lists
     (typed keys encoded by parallel/keys.py): rows match when ALL words are
-    equal. Returns (lkeys list, lvals list, rvals list, rmatched, live,
+    equal. `lmatch`/`rmatch` (default: the alive masks) restrict MATCHING
+    without affecting emission — a null-keyed left row under `outer` is
+    still emitted, just never matched (Spark equi-join NULL semantics).
+    Returns (lkeys list, lvals list, rvals list, rmatched, live,
     overflow-scalar); rmatched is False on left-outer rows with no match
     (their rval slots are 0 and must be read as null)."""
     from ..ops.join import _expand, _match_spans, _union_ranks
     lks, rks = _as_list(lk), _as_list(rk)
     lvs, rvs = _as_list(lv), _as_list(rv)
+    lmatch = lalive if lmatch is None else lmatch
+    rmatch = ralive if rmatch is None else rmatch
     nl = lks[0].shape[0]
     if outer:
         # dead (padded) rows also get an output slot under outer expansion's
@@ -346,9 +351,10 @@ def _local_join_tail(lk, lv, lalive, rk, rv, ralive, row_cap: int,
         lks = [jnp.take(k, order, axis=0) for k in lks]
         lvs = [jnp.take(v, order, axis=0) for v in lvs]
         lalive = jnp.take(lalive, order, axis=0)
+        lmatch = jnp.take(lmatch, order, axis=0)
     operands = tuple(jnp.concatenate([a, b]) for a, b in zip(lks, rks))
     ranks = _union_ranks(operands, n_ops=len(operands))
-    counts, lo, rorder = _match_spans(ranks[:nl], lalive, ranks[nl:], ralive)
+    counts, lo, rorder = _match_spans(ranks[:nl], lmatch, ranks[nl:], rmatch)
     lsel, rsel = _expand(counts, lo, rorder, total=row_cap, outer=outer)
     if outer:
         total = jnp.sum(jnp.where(lalive, jnp.maximum(counts, 1), 0))
@@ -416,29 +422,28 @@ def distributed_inner_join(mesh: Mesh, lkeys: jnp.ndarray, lvals: jnp.ndarray,
     return fn(lkeys, lvals, rkeys, rvals)
 
 
-def distributed_inner_join_keyed(mesh: Mesh, l_words: Sequence[jnp.ndarray],
-                                 lvals: Sequence[jnp.ndarray],
-                                 r_words: Sequence[jnp.ndarray],
-                                 rvals: Sequence[jnp.ndarray],
-                                 key_specs, row_cap: int, slack: float = 2.0,
-                                 axis: str = "data"):
-    """Typed-key inner join: key sides are word lists from
-    keys.encode_key_columns (string/decimal128/float/nullable keys all ride
-    the same machinery); placement is Spark-exact via
-    keys.spark_partition_hash. Returns per-shard padded
-    ([l key words], [lvals], [rvals], valid, overflow) — decode the key
-    words back to typed columns with keys.decode_key_columns."""
-    from .keys import spark_partition_hash
-    n_peers = mesh.shape[axis]
-    hash_fn = lambda ws: spark_partition_hash(ws, key_specs)  # noqa: E731
-    l_words, lvals = list(l_words), list(lvals)
-    r_words, rvals = list(r_words), list(rvals)
+def _check_word_counts(l_words, r_words):
     if len(r_words) != len(l_words):
         # encode both sides with the SAME static max_bytes — auto-derived
         # widths differ per side and would silently mis-slice the arg tuple
         raise ValueError(
             f"join key word counts differ: left {len(l_words)} vs right "
             f"{len(r_words)}; encode both sides with identical KeySpecs")
+
+
+def _distributed_join_keyed(mesh, l_words, lvals, r_words, rvals, key_specs,
+                            row_cap, slack, axis, outer):
+    """Shared typed-key equi-join body (inner / left-outer): exchange both
+    sides by the Spark-exact hash of the words, join shard-locally. NULL
+    keys never match (keys.keys_null_mask feeds the match masks), matching
+    Spark's `l.k = r.k` semantics — under `outer` a null-keyed left row is
+    emitted null-extended."""
+    from .keys import keys_null_mask, spark_partition_hash
+    n_peers = mesh.shape[axis]
+    hash_fn = lambda ws: spark_partition_hash(ws, key_specs)  # noqa: E731
+    l_words, lvals = list(l_words), list(lvals)
+    r_words, rvals = list(r_words), list(rvals)
+    _check_word_counts(l_words, r_words)
     nw, nlv = len(l_words), len(lvals)
 
     def local(*arrs):
@@ -450,19 +455,42 @@ def distributed_inner_join_keyed(mesh: Mesh, l_words: Sequence[jnp.ndarray],
             axis, n_peers, slack, lw, lv, hash_fn)
         Rw, Rv, Ralive, rspill = _hash_exchange(
             axis, n_peers, slack, rw, rv, hash_fn)
-        out_lw, out_lv, out_rv, _, live, joverflow = _local_join_tail(
-            Lw, Lv, Lalive, Rw, Rv, Ralive, row_cap)
+        lmatch = Lalive & ~keys_null_mask(Lw, key_specs)
+        rmatch = Ralive & ~keys_null_mask(Rw, key_specs)
+        out_lw, out_lv, out_rv, rvalid, live, joverflow = _local_join_tail(
+            Lw, Lv, Lalive, Rw, Rv, Ralive, row_cap, outer=outer,
+            lmatch=lmatch, rmatch=rmatch)
         overflow = joverflow | lspill | rspill
-        return (tuple(out_lw), tuple(out_lv), tuple(out_rv), live,
-                overflow.reshape(1))
+        outs = (tuple(out_lw), tuple(out_lv), tuple(out_rv))
+        if outer:
+            return outs + (rvalid, live, overflow.reshape(1))
+        return outs + (live, overflow.reshape(1))
 
     spec = P(axis)
+    n_flags = 3 if outer else 2
     fn = shard_map(
         local, mesh=mesh,
         in_specs=(spec,) * (2 * nw + nlv + len(rvals)),
         out_specs=(tuple(spec for _ in l_words), tuple(spec for _ in lvals),
-                   tuple(spec for _ in rvals), spec, spec))
+                   tuple(spec for _ in rvals)) + (spec,) * n_flags)
     return fn(*l_words, *lvals, *r_words, *rvals)
+
+
+def distributed_inner_join_keyed(mesh: Mesh, l_words: Sequence[jnp.ndarray],
+                                 lvals: Sequence[jnp.ndarray],
+                                 r_words: Sequence[jnp.ndarray],
+                                 rvals: Sequence[jnp.ndarray],
+                                 key_specs, row_cap: int, slack: float = 2.0,
+                                 axis: str = "data"):
+    """Typed-key inner join: key sides are word lists from
+    keys.encode_key_columns (string/decimal128/float/nullable keys all ride
+    the same machinery); placement is Spark-exact via
+    keys.spark_partition_hash; NULL keys never match. Returns per-shard
+    padded ([l key words], [lvals], [rvals], valid, overflow) — decode the
+    key words back to typed columns with keys.decode_key_columns."""
+    return _distributed_join_keyed(mesh, l_words, lvals, r_words, rvals,
+                                   key_specs, row_cap, slack, axis,
+                                   outer=False)
 
 
 def distributed_broadcast_join(mesh: Mesh, lkeys: jnp.ndarray,
@@ -494,6 +522,21 @@ def distributed_broadcast_join(mesh: Mesh, lkeys: jnp.ndarray,
     fn = shard_map(local, mesh=mesh, in_specs=(spec,) * 4,
                    out_specs=(spec,) * 5)
     return fn(lkeys, lvals, rkeys, rvals)
+
+
+def distributed_left_join_keyed(mesh: Mesh, l_words: Sequence[jnp.ndarray],
+                                lvals: Sequence[jnp.ndarray],
+                                r_words: Sequence[jnp.ndarray],
+                                rvals: Sequence[jnp.ndarray],
+                                key_specs, row_cap: int, slack: float = 2.0,
+                                axis: str = "data"):
+    """Typed-key left-outer join (see distributed_inner_join_keyed).
+    Returns per-shard padded ([l key words], [lvals], [rvals], rvalid,
+    valid, overflow); rvalid is False on unmatched left rows — including
+    null-keyed left rows, which never match but are still emitted."""
+    return _distributed_join_keyed(mesh, l_words, lvals, r_words, rvals,
+                                   key_specs, row_cap, slack, axis,
+                                   outer=True)
 
 
 def distributed_left_join(mesh: Mesh, lkeys: jnp.ndarray, lvals: jnp.ndarray,
@@ -548,6 +591,66 @@ def _distributed_semi_anti(mesh, lkeys, lvals, rkeys, semi, slack, axis):
     fn = shard_map(local, mesh=mesh, in_specs=(spec,) * 3,
                    out_specs=(spec,) * 4)
     return fn(lkeys, lvals, rkeys)
+
+
+def _distributed_semi_anti_keyed(mesh, l_words, lvals, r_words, key_specs,
+                                 semi, slack, axis):
+    """Typed-key shared body: keys as word lists, same marking logic.
+    NULL keys never match (Spark equi-join semantics): a null-keyed left
+    row is dropped by semi and kept by anti."""
+    from ..ops.join import _match_spans, _union_ranks
+    from .keys import keys_null_mask, spark_partition_hash
+    n_peers = mesh.shape[axis]
+    hash_fn = lambda ws: spark_partition_hash(ws, key_specs)  # noqa: E731
+    l_words, lvals = list(l_words), list(lvals)
+    r_words = list(r_words)
+    _check_word_counts(l_words, r_words)
+    nw, nlv = len(l_words), len(lvals)
+
+    def local(*arrs):
+        lw = list(arrs[:nw])
+        lv = list(arrs[nw:nw + nlv])
+        rw = list(arrs[nw + nlv:])
+        Lw, Lv, Lalive, lspill = _hash_exchange(
+            axis, n_peers, slack, lw, lv, hash_fn)
+        Rw, _, Ralive, rspill = _hash_exchange(
+            axis, n_peers, slack, rw, None, hash_fn)
+        lmatch = Lalive & ~keys_null_mask(Lw, key_specs)
+        rmatch = Ralive & ~keys_null_mask(Rw, key_specs)
+        nl = Lw[0].shape[0]
+        operands = tuple(jnp.concatenate([a, b]) for a, b in zip(Lw, Rw))
+        ranks = _union_ranks(operands, n_ops=len(operands))
+        counts, _, _ = _match_spans(ranks[:nl], lmatch, ranks[nl:], rmatch)
+        hit = counts > 0
+        keep = Lalive & (hit if semi else ~hit)
+        out_lw = [jnp.where(keep, w, 0) for w in Lw]
+        out_lv = [jnp.where(keep, v, 0) for v in Lv]
+        overflow = lspill | rspill
+        return tuple(out_lw), tuple(out_lv), keep, overflow.reshape(1)
+
+    spec = P(axis)
+    fn = shard_map(
+        local, mesh=mesh, in_specs=(spec,) * (2 * nw + nlv),
+        out_specs=(tuple(spec for _ in l_words), tuple(spec for _ in lvals),
+                   spec, spec))
+    return fn(*l_words, *lvals, *r_words)
+
+
+def distributed_left_semi_join_keyed(mesh, l_words, lvals, r_words,
+                                     key_specs, slack: float = 2.0,
+                                     axis: str = "data"):
+    """Typed-key left-semi join: left rows with at least one match.
+    Returns per-shard padded ([l key words], [lvals], valid, overflow)."""
+    return _distributed_semi_anti_keyed(mesh, l_words, lvals, r_words,
+                                        key_specs, True, slack, axis)
+
+
+def distributed_left_anti_join_keyed(mesh, l_words, lvals, r_words,
+                                     key_specs, slack: float = 2.0,
+                                     axis: str = "data"):
+    """Typed-key left-anti join: left rows with no match."""
+    return _distributed_semi_anti_keyed(mesh, l_words, lvals, r_words,
+                                        key_specs, False, slack, axis)
 
 
 def distributed_left_semi_join(mesh: Mesh, lkeys: jnp.ndarray,

@@ -16,6 +16,9 @@ from spark_rapids_tpu.ops import groupby_aggregate, murmur_hash3_32
 from spark_rapids_tpu.parallel import (decode_key_columns,
                                        distributed_groupby_keyed,
                                        distributed_inner_join_keyed,
+                                       distributed_left_anti_join_keyed,
+                                       distributed_left_join_keyed,
+                                       distributed_left_semi_join_keyed,
                                        encode_key_columns, make_mesh,
                                        spark_partition_hash)
 
@@ -125,6 +128,9 @@ def test_distributed_groupby_string_keys():
     assert got == {k: tuple(a) for k, a in expect.items()}
 
 
+@pytest.mark.nightly  # dtype handled entirely by the word codec, whose
+# decimal128 round-trip + Spark-hash parity run in the default tier above;
+# the mesh plumbing it exercises is identical to the string-key test
 def test_distributed_groupby_decimal128_nullable_keys():
     mesh = _mesh()
     rng = np.random.default_rng(4)
@@ -186,3 +192,91 @@ def test_distributed_inner_join_string_keys():
                     for k, a in zip(l_py, lv)
                     for kk, b in zip(r_py, rv) if k == kk)
     assert got == expect
+
+
+@pytest.mark.nightly  # same shuffle body as the default-tier inner-join
+# test; the outer/semi/anti tails are extra SPMD traces
+def test_distributed_left_and_semi_anti_joins_string_keys():
+    mesh = _mesh()
+    n = 8 * 8
+    vocab = ["a", "b", "c", None]                    # incl. a NULL key
+    l_py = [vocab[i % 4] for i in range(n)]
+    r_py = ["a", "b", None, "b"] * (n // 4)          # null on both sides
+    lv = np.arange(n, dtype=np.int64)
+    rv = np.arange(n, dtype=np.int64) + 500
+
+    lw, specs = encode_key_columns([Column.from_pylist(l_py, dtypes.STRING)],
+                                   max_bytes=8)
+    rw, _ = encode_key_columns([Column.from_pylist(r_py, dtypes.STRING)],
+                               max_bytes=8)
+    shl = [_shard(mesh, w) for w in lw]
+    shr = [_shard(mesh, w) for w in rw]
+    slv, srv = _shard(mesh, lv), _shard(mesh, rv)
+
+    # left-outer: every left row appears; unmatched rows have rvalid False
+    ow, (olv,), (orv,), rvalid, valid, overflow = distributed_left_join_keyed(
+        mesh, shl, [slv], shr, [srv], specs, row_cap=n * n, slack=float(NDEV))
+    assert not bool(np.asarray(overflow).any())
+    v = np.asarray(valid)
+    rv_ok = np.asarray(rvalid)
+    keys_back = decode_key_columns([jnp.asarray(w) for w in ow], specs,
+                                   alive=jnp.asarray(valid))[0].to_pylist()
+    matched = {keys_back[i] for i in np.nonzero(v & rv_ok)[0]}
+    unmatched = {keys_back[i] for i in np.nonzero(v & ~rv_ok)[0]}
+    # NULL never matches NULL (Spark equi-join): null-keyed left rows are
+    # emitted null-extended, never paired with the null-keyed right rows
+    assert matched == {"a", "b"} and unmatched == {"c", None}
+
+    # semi: only matching left rows; anti: the complement
+    sw, (sv_,), svalid, soverflow = distributed_left_semi_join_keyed(
+        mesh, shl, [slv], shr, specs, slack=float(NDEV))
+    assert not bool(np.asarray(soverflow).any())
+    semi_rows = [decode_key_columns(
+        [jnp.asarray(w) for w in sw], specs,
+        alive=jnp.asarray(svalid))[0].to_pylist()[i]
+        for i in np.nonzero(np.asarray(svalid))[0]]
+    # semi keeps only genuinely-matching rows; NULL-keyed rows never match
+    assert set(semi_rows) == {"a", "b"}
+
+    aw, (av_,), avalid, aoverflow = distributed_left_anti_join_keyed(
+        mesh, shl, [slv], shr, specs, slack=float(NDEV))
+    assert not bool(np.asarray(aoverflow).any())
+    anti_rows = [decode_key_columns(
+        [jnp.asarray(w) for w in aw], specs,
+        alive=jnp.asarray(avalid))[0].to_pylist()[i]
+        for i in np.nonzero(np.asarray(avalid))[0]]
+    # anti keeps the non-matching rows INCLUDING null-keyed ones (the
+    # predicate is never true on NULL, so the row survives)
+    assert set(anti_rows) == {"c", None}
+
+
+def test_keyed_left_join_null_keys_default_tier():
+    """Default-tier proof of the keyed outer tail + NULL-key semantics in
+    ONE SPMD trace: null-keyed left rows emit null-extended; null-keyed
+    right rows match nothing."""
+    mesh = _mesh()
+    n = 8 * 4
+    l_py = (["m", None] * (n // 2))
+    r_py = (["m", None] * (n // 2))
+    lv = np.arange(n, dtype=np.int64)
+    rv = np.arange(n, dtype=np.int64) + 100
+
+    lw, specs = encode_key_columns([Column.from_pylist(l_py, dtypes.STRING)],
+                                   max_bytes=8)
+    rw, _ = encode_key_columns([Column.from_pylist(r_py, dtypes.STRING)],
+                               max_bytes=8)
+    ow, (olv,), (orv,), rvalid, valid, overflow = distributed_left_join_keyed(
+        mesh, [_shard(mesh, w) for w in lw], [_shard(mesh, lv)],
+        [_shard(mesh, w) for w in rw], [_shard(mesh, rv)],
+        specs, row_cap=n * n, slack=float(NDEV))
+    assert not bool(np.asarray(overflow).any())
+    v = np.asarray(valid)
+    rm = np.asarray(rvalid)
+    keys_back = decode_key_columns([jnp.asarray(w) for w in ow], specs,
+                                   alive=jnp.asarray(valid))[0].to_pylist()
+    matched_keys = {keys_back[i] for i in np.nonzero(v & rm)[0]}
+    null_extended = [keys_back[i] for i in np.nonzero(v & ~rm)[0]]
+    assert matched_keys == {"m"}                 # real matches: (n/2)^2 pairs
+    assert int((v & rm).sum()) == (n // 2) ** 2
+    # every null-keyed left row is emitted exactly once, unmatched
+    assert null_extended.count(None) == n // 2
