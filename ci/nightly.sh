@@ -5,7 +5,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-python -m pytest tests/ -q
+python -m pytest tests/ -q -m ""    # include the nightly-marked tier
 python benchmarks/run_all.py --scale 0.01 --iters 5
 ./ci/fuzz-test.sh
 ./ci/sanitizer.sh

@@ -31,19 +31,23 @@ def _shard(mesh, arr):
 
 
 def test_groupby_overflows_then_heals():
+    # one doubling: 40 distinct keys overflow key_cap=32, heal at 64 — the
+    # final caps prove the retry happened (each capacity is a separate SPMD
+    # trace on a single-core box, so the default tier keeps this to two
+    # programs; the deep-escalation variants are nightly)
     mesh = _mesh()
     rng = np.random.default_rng(0)
     n = 8 * 64
-    keys = rng.integers(0, 40, n).astype(np.int64)   # 40 keys > key_cap 4
+    keys = rng.integers(0, 40, n).astype(np.int64)   # 40 keys > key_cap 32
     vals = rng.integers(0, 10, n).astype(np.int64)
     sk, sv = _shard(mesh, keys), _shard(mesh, vals)
 
-    # the starting cap really is too small
-    _, _, _, overflow = distributed_groupby(mesh, sk, sv, ["sum"], key_cap=4)
-    assert bool(np.asarray(overflow).any())
-
-    gk, (gsum,), gvalid, overflow = distributed_groupby_auto(
-        mesh, sk, sv, ["sum"], key_cap=4)
+    out, caps = auto_retry_overflow(
+        lambda key_cap: distributed_groupby(mesh, sk, sv, ["sum"],
+                                            key_cap=key_cap),
+        {"key_cap": 32})
+    gk, (gsum,), gvalid, overflow = out
+    assert caps["key_cap"] == 64                     # exactly one retry
     assert not bool(np.asarray(overflow).any())
 
     got = {}
@@ -57,6 +61,7 @@ def test_groupby_overflows_then_heals():
     assert got == expect
 
 
+@pytest.mark.nightly
 def test_skewed_join_overflows_at_slack_one_then_heals():
     # every left row carries ONE hot key: with slack=1 each shard's bucket
     # for the hot key's home shard spills, and the starting row_cap is far

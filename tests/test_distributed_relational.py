@@ -46,12 +46,36 @@ def test_distributed_groupby_matches_local():
     keys = rng.integers(0, 100, n).astype(np.int64)
     vals = rng.integers(-1000, 1000, n).astype(np.int64)
 
+    # default tier: sum (span-sum path) + max (associative-scan path); the
+    # four-agg variant is nightly — every extra agg column lengthens the
+    # single-core SPMD trace
+    gk, gout, gvalid, overflow = distributed_groupby(
+        mesh, _shard(mesh, keys), _shard(mesh, vals),
+        ["sum", "max"], key_cap=512)
+    assert not bool(np.asarray(overflow).any())
+    got = _collect_groupby(gk, gout, gvalid)
+
+    t = Table([Column.from_numpy(keys), Column.from_numpy(vals)],
+              names=["k", "v"])
+    ref = groupby_aggregate(t, ["k"], [("v", "sum"), ("v", "max")])
+    expect = {k: (s, mx) for k, s, mx in zip(
+        ref["k"].to_pylist(), ref["sum(v)"].to_pylist(),
+        ref["max(v)"].to_pylist())}
+    assert got == expect
+
+
+@pytest.mark.nightly
+def test_distributed_groupby_all_aggs_matches_local():
+    mesh = _mesh()
+    rng = np.random.default_rng(0)
+    n = 8 * 512
+    keys = rng.integers(0, 100, n).astype(np.int64)
+    vals = rng.integers(-1000, 1000, n).astype(np.int64)
     gk, gout, gvalid, overflow = distributed_groupby(
         mesh, _shard(mesh, keys), _shard(mesh, vals),
         ["sum", "count", "min", "max"], key_cap=512)
     assert not bool(np.asarray(overflow).any())
     got = _collect_groupby(gk, gout, gvalid)
-
     t = Table([Column.from_numpy(keys), Column.from_numpy(vals)],
               names=["k", "v"])
     ref = groupby_aggregate(t, ["k"], [("v", "sum"), ("v", "count"),
@@ -73,6 +97,7 @@ def test_distributed_groupby_overflow_flag():
     assert bool(np.asarray(overflow).any())
 
 
+@pytest.mark.nightly
 def test_key_cap_larger_than_shard_rows():
     # generous key_cap must not crash when it exceeds per-shard row count
     mesh = _mesh()
@@ -87,6 +112,7 @@ def test_key_cap_larger_than_shard_rows():
     assert got == expect
 
 
+@pytest.mark.nightly
 def test_exact_capacity_no_false_overflow():
     # a shard owning exactly key_cap keys is NOT overflow (the phantom
     # dead-key group from all-to-all padding must not count)
@@ -248,6 +274,7 @@ def test_distributed_semi_anti_join():
     assert got == want
 
 
+@pytest.mark.nightly
 def test_distributed_groupby_multi_key():
     from spark_rapids_tpu.parallel import distributed_groupby_multi
     mesh = _mesh()
@@ -277,6 +304,7 @@ def test_distributed_groupby_multi_key():
         assert [int(x), int(y), int(z)] == [int(q) for q in want[key]], key
 
 
+@pytest.mark.nightly
 def test_distributed_groupby_multi_count_only():
     from spark_rapids_tpu.parallel import distributed_groupby_multi
     mesh = _mesh()

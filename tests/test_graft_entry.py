@@ -1,6 +1,8 @@
 """Driver interface guard: entry() must jit-compile and dryrun_multichip
 must run on the virtual mesh — regressions here would only surface in the
 driver's own validation otherwise."""
+import pytest
+
 import jax
 import numpy as np
 
@@ -24,6 +26,9 @@ def test_entry_compiles_and_runs():
     assert u == int(vals[7]) ** 2
 
 
+@pytest.mark.nightly  # the driver runs dryrun_multichip(8) itself every
+# round (MULTICHIP check) — in the default tier this multi-minute SPMD
+# trace would duplicate that external gate on the single-core box
 def test_dryrun_multichip_eight():
     import __graft_entry__ as g
     g.dryrun_multichip(8)
