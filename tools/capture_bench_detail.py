@@ -1,0 +1,120 @@
+"""Capture the staged-config benchmarks into BENCH_DETAIL.md.
+
+Runs every micro-bench (benchmarks/run_all.py's set) in a child process the
+parent can time out — the TPU backend on this image can hang at init
+(bench.py learned the same lesson) — and writes the parsed records plus a
+roofline note per op into BENCH_DETAIL.md with the backend clearly marked.
+
+Usage:
+    python tools/capture_bench_detail.py             # full scale
+    python tools/capture_bench_detail.py --scale 0.01 --cpu   # smoke
+"""
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCHES = [
+    ("row_conversion", "benchmarks/bench_row_conversion.py",
+     "HBM-bandwidth bound: one bitcast + concatenate per direction; "
+     "bytes/s is the roofline metric"),
+    ("groupby", "benchmarks/bench_groupby.py",
+     "lax.sort bound (multi-operand sort + cumsum spans, scatter-free)"),
+    ("join", "benchmarks/bench_join.py",
+     "three lax.sort passes (union rank + two span sorts); "
+     "searchsorted-free"),
+    ("parquet_read", "benchmarks/bench_parquet_read.py",
+     "host decode (native C++) + device_put; decompression bound"),
+    ("cast_string_to_float", "benchmarks/bench_cast_string_to_float.py",
+     "VPU elementwise over the padded char matrix"),
+    ("bloom_filter", "benchmarks/bench_bloom_filter.py",
+     "hash (VPU) + sorted-scatter bit set; scatter is the ceiling"),
+    ("parse_uri", "benchmarks/bench_parse_uri.py",
+     "VPU class-table lookups over padded chars"),
+    ("partition", "benchmarks/bench_partition.py",
+     "A/B: sort+searchsorted vs streaming compare-reduce vs pallas "
+     "histogram — the shuffle bucket-map decision"),
+]
+TIMEOUT_S = 600
+
+
+def run_bench(path: str, scale: float, iters: int, cpu: bool):
+    code = (
+        "import jax\n"
+        + ("jax.config.update('jax_platforms', 'cpu')\n" if cpu else "")
+        + "import runpy, sys\n"
+        + f"sys.argv = ['bench', '--scale', '{scale}', '--iters', '{iters}']\n"
+        + f"runpy.run_path({path!r}, run_name='__main__')\n")
+    try:
+        p = subprocess.run([sys.executable, "-c", code], cwd=ROOT,
+                           capture_output=True, text=True, timeout=TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        return None, "timed out (backend hang?)"
+    recs = []
+    for line in p.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                recs.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    if p.returncode != 0 and not recs:
+        return None, p.stderr.strip()[-300:]
+    return recs, None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the CPU backend (tunnel down / smoke)")
+    ap.add_argument("--out", default=os.path.join(ROOT, "BENCH_DETAIL.md"))
+    args = ap.parse_args(argv)
+
+    backend = "cpu (pinned)" if args.cpu else "default (TPU when up)"
+    lines = [
+        "# BENCH_DETAIL — staged-config measurements",
+        "",
+        f"Captured {datetime.date.today()} · backend: {backend} · "
+        f"scale {args.scale} · {args.iters} iters/steady-state.",
+        "Records are `benchmarks/*` JSON lines (nvbench-equivalent harness,",
+        "SURVEY.md §2.3); rows/s computed over the config's num_rows.",
+        "",
+    ]
+    if args.cpu:
+        lines += [
+            "> **Status:** CPU-pinned capture (the axon TPU tunnel hangs at",
+            "> backend init — see PARITY.md). Re-run this tool WITHOUT",
+            "> `--cpu` at full scale when the chip is reachable; the numbers",
+            "> below establish the harness and the relative A/B shape only.",
+            "> Pallas interpret-mode rows are meaningless off-chip by design.",
+            "",
+        ]
+    for name, path, roofline in BENCHES:
+        print(f"== {name}", flush=True)
+        recs, err = run_bench(path, args.scale, args.iters, args.cpu)
+        lines.append(f"## {name}")
+        lines.append("")
+        lines.append(f"Roofline: {roofline}.")
+        lines.append("")
+        if err and not recs:
+            lines.append(f"**capture failed:** {err}")
+            lines.append("")
+            continue
+        lines.append("| bench | axes | ms | rows/s |")
+        lines.append("|---|---|---|---|")
+        for r in recs:
+            lines.append(f"| {r.get('bench')} | `{r.get('axes')}` | "
+                         f"{r.get('ms')} | {r.get('rows_per_s'):,} |")
+        lines.append("")
+    with open(args.out, "w") as f:
+        f.write("\n".join(lines))
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
