@@ -86,7 +86,19 @@ def bloom_filter_put(bf: BloomFilter, col: Column,
     so this is a scatter-max over the unpacked bit vector. `sort_indices=True`
     sorts the bit positions first and passes `indices_are_sorted` to the
     scatter — one extra sort buys XLA's much cheaper sorted-scatter lowering
-    on TPU; pick per batch size (the bench sweeps both)."""
+    on TPU; pick per batch size (the bench sweeps both).
+
+    Pallas finding (round-2 mandate): an explicit TPU kernel does not have
+    a path that beats this. TPU Pallas has no atomics either, so a kernel
+    must serialize bit-sets; the two candidate shapes both lose —
+    (a) one-hot OR accumulation compares every row block against every
+    bits word: O(rows x num_bits/128) VPU ops, ~500x more work than the
+    hash itself for Spark's 1-8 MiB filters; (b) per-row scalar stores
+    into a VMEM-resident bits buffer is exactly what XLA's sorted-scatter
+    lowering already emits, minus its run-length coalescing of duplicate
+    words. The sort+scatter formulation IS the TPU-native atomicOr
+    (benchmarks/bench_bloom_filter.py carries the A/B of both scatter
+    modes)."""
     if col.dtype.kind != Kind.INT64:
         raise TypeError("bloom filter input must be INT64")
     idx = _spark_bit_indexes(col.data, bf.num_hashes, bf.num_bits)

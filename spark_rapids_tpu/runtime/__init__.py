@@ -16,11 +16,12 @@ from .pool import (DeviceSession, MemoryBudget, MemoryEventHandler,
 from .retry import with_retry
 from .admission import (set_active_session, get_active_session,
                         active_session, admitted_op, operand_nbytes)
-from .spill import SpillPool, SpillableBuffer
+from .spill import SpillPool, SpillableBuffer, SpillableTable
 
 __all__ = [
     "set_active_session", "get_active_session", "active_session",
     "admitted_op", "operand_nbytes", "SpillPool", "SpillableBuffer",
+    "SpillableTable",
     "ResourceArbiter", "OomInjectionType", "current_thread_id",
     "ArbiterOOM", "RetryOOM", "SplitAndRetryOOM", "CpuRetryOOM",
     "CpuSplitAndRetryOOM", "HardOOM", "InjectedException", "ThreadRemovedError",

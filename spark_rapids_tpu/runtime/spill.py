@@ -37,6 +37,7 @@ class SpillableBuffer:
         self._host: Optional[np.ndarray] = None
         self._reservation: Optional[Reservation] = reservation
         self.nbytes = array_nbytes(array)
+        self._pinned = False
         self._mu = threading.Lock()
 
     @property
@@ -44,11 +45,29 @@ class SpillableBuffer:
         with self._mu:
             return self._device is None
 
+    @property
+    def pinned(self) -> bool:
+        with self._mu:
+            return self._pinned
+
+    def pin(self) -> None:
+        """Exclude this buffer from spilling while it is in active use —
+        the reference's spillable-state contract: a batch is spillable
+        only while its task is NOT computing on it (RmmSpark.java:402-416
+        'make the inputs spillable' happens on rollback, and the retry
+        unspills before touching them)."""
+        with self._mu:
+            self._pinned = True
+
+    def unpin(self) -> None:
+        with self._mu:
+            self._pinned = False
+
     def spill(self) -> int:
         """Move to host, delete the device buffer, free the budget.
-        Returns bytes freed (0 if already spilled)."""
+        Returns bytes freed (0 if already spilled or pinned)."""
         with self._mu:
-            if self._device is None:
+            if self._device is None or self._pinned:
                 return 0
             self._host = np.asarray(self._device)     # D2H copy
             self._device.delete()                     # drop the HBM buffer
@@ -92,6 +111,113 @@ class SpillableBuffer:
             r, self._reservation = self._reservation, None
         if r is not None:
             self._pool.budget.release(r)
+
+
+class SpillableTable:
+    """A Table whose buffers live in a SpillPool — the 'make inputs
+    spillable' half of the recovery contract (RmmSpark.java:402-416: catch
+    RetryOOM → make inputs spillable → block until ready → retry).
+
+    `protect()` registers every device buffer of the table (first call) and
+    marks them spillable — call it on rollback, while the task is NOT
+    computing on the table. `get()` restores any spilled buffers through
+    budget admission and PINS them (in active use: the pool must not
+    delete arrays a running op reads). Use as the `on_rollback` of
+    runtime.retry.with_retry:
+
+        st = SpillableTable(pool, table)
+        out = with_retry(arbiter, lambda t: op(st.get()), table,
+                         on_rollback=st.protect, split=...)
+        st.close()
+    """
+
+    def __init__(self, pool: "SpillPool", table):
+        self._pool = pool
+        self._table = table
+        self._protected = False
+        self._closed = False
+
+    def protect(self) -> None:
+        """Register the buffers (first call) and make them spillable:
+        the rollback half of the recovery contract."""
+        if self._closed:
+            raise RuntimeError("SpillableTable is closed")
+        if not self._protected:
+            self._protected = True
+            leaves, self._treedef = jax.tree_util.tree_flatten(self._table)
+            self._slots = []
+            seen: Dict[int, SpillableBuffer] = {}   # alias-safe: one
+            for leaf in leaves:                     # buffer per device array
+                if isinstance(leaf, jax.Array):
+                    buf = seen.get(id(leaf))
+                    if buf is None:
+                        buf = self._pool.register(leaf)
+                        seen[id(leaf)] = buf
+                    self._slots.append(buf)
+                else:
+                    self._slots.append(leaf)
+            self._table = None         # drop the direct strong refs
+        for s in self._unique_buffers():
+            s.unpin()
+
+    def _unique_buffers(self):
+        seen = set()
+        for s in self._slots:
+            if isinstance(s, SpillableBuffer) and id(s) not in seen:
+                seen.add(id(s))
+                yield s
+
+    def get(self):
+        """The live Table, pinned for use; restores spilled buffers
+        (admitted — a restore under pressure can spill OTHER unpinned
+        buffers or block through the retry protocol). Balance with
+        unpin() (or use()) once the op is done, so idle inputs stay
+        spillable for other tasks."""
+        if self._closed:
+            raise RuntimeError("SpillableTable is closed")
+        if not self._protected:
+            return self._table
+        leaves = []
+        for s in self._slots:
+            if isinstance(s, SpillableBuffer):
+                # pin FIRST: a pinned buffer cannot be spilled, so the
+                # array returned by get() below is guaranteed to stay live
+                s.pin()
+                leaves.append(s.get())
+            else:
+                leaves.append(s)
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    def unpin(self) -> None:
+        """Make the buffers spillable again (op finished with them)."""
+        if self._protected and not self._closed:
+            for s in self._unique_buffers():
+                s.unpin()
+
+    def use(self):
+        """Context manager: pinned table inside, spillable again outside.
+
+            with st.use() as t:
+                out = op(t)
+        """
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            try:
+                yield self.get()
+            finally:
+                self.unpin()
+        return cm()
+
+    def close(self) -> None:
+        self._closed = True
+        if not self._protected:
+            self._table = None
+            return
+        for s in self._unique_buffers():
+            self._pool.unregister(s)
+        self._slots = []
 
 
 class SpillPool(MemoryEventHandler):
@@ -138,7 +264,7 @@ class SpillPool(MemoryEventHandler):
         freed = 0
         with self._mu:
             candidates = [b for _, b in sorted(self._buffers.items())
-                          if not b.spilled]
+                          if not b.spilled and not b.pinned]
             for b in candidates:
                 freed += b.spill()
                 if freed >= nbytes:

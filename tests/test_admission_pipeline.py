@@ -162,3 +162,100 @@ def test_reservation_follows_output_lifetime(no_global_session):
             assert session.device.used == 0
         finally:
             actor.done()
+
+
+def test_spillable_table_rollback_and_pinned_use(no_global_session):
+    """The spillable-inputs half of the recovery contract
+    (RmmSpark.java:402-416): protect() makes an idle task's inputs
+    revocable, pressure from another op spills them, get() restores them
+    through admission and PINS them so no later pressure can delete arrays
+    an op is computing on — pressure against fully-pinned memory falls
+    through to the task-level RetryOOM instead."""
+    from spark_rapids_tpu.runtime import SpillableTable
+
+    table, pdf = _sales_table(n=30_000)
+    input_bytes = operand_nbytes(table)
+    # 3.2x: fits inputs (1x) + the groupby working set (2x) when pinned,
+    # but not the pressure ops below
+    session = DeviceSession(int(3.2 * input_bytes))
+    pool = SpillPool().attach(session.device)
+    with session:
+        set_active_session(session)
+        actor = TaskActor(session, task_id=9).start()
+        try:
+            st = SpillableTable(pool, table)
+            actor.run(st.protect)                  # idle: spillable
+            assert session.device.used == input_bytes
+
+            # another op's working set (1.5x its 800 KiB input) cannot fit
+            # beside the resident inputs: the pool must revoke them
+            big = Column.from_numpy(np.arange(100_000, dtype=np.int64))
+            h = actor.run(lambda: murmur_hash3_32(Table([big]), seed=1))
+            assert pool.spill_count >= 1
+            del h, big
+            actor.run(lambda: None)
+            gc.collect()
+
+            # get() restores through admission and pins; the groupby then
+            # runs on guaranteed-live arrays and matches the oracle
+            def run_agg():
+                t = st.get()               # restores + pins
+                return groupby_aggregate(
+                    t, ["item"], [("rev", "sum"), ("rev", "count")])
+
+            final = actor.run(run_agg, timeout=60)
+
+            # pinned inputs survive fresh pressure: an op too big for the
+            # remaining budget gets RetryOOM (fall-through), and the
+            # pinned arrays are still live afterwards
+            big2 = Column.from_numpy(
+                np.arange(40_000, dtype=np.int64))
+            with pytest.raises(RetryOOM):
+                actor.run(lambda: murmur_hash3_32(
+                    Table([big2, big2, big2, big2]), seed=2), timeout=60)
+            # protocol (RmmSpark.java:402): after RetryOOM, acknowledge via
+            # block-until-ready; with every byte pinned the arbiter answers
+            # with the split escalation, and the doomed op gives up — the
+            # thread returns to RUNNING
+            from spark_rapids_tpu.runtime import SplitAndRetryOOM
+            with pytest.raises(SplitAndRetryOOM):
+                actor.run(session.arbiter.block_thread_until_ready,
+                          timeout=60)
+            again = actor.run(run_agg, timeout=60)
+            np.testing.assert_array_equal(np.asarray(final[0].data),
+                                          np.asarray(again[0].data))
+
+            # unpin: the inputs are idle again and pressure (the same-sized
+            # op as the first spill phase) succeeds by spilling them
+            actor.run(st.unpin)
+            spills_before = pool.spill_count
+            big3 = Column.from_numpy(np.arange(100_000, dtype=np.int64))
+            h2 = actor.run(lambda: murmur_hash3_32(Table([big3]), seed=3),
+                           timeout=60)
+            assert h2.length == 100_000
+            assert pool.spill_count > spills_before
+            del h2
+            actor.run(lambda: None)
+            gc.collect()
+
+            # use(): pinned inside the context, spillable after
+            def run_use():
+                with st.use() as t:
+                    return groupby_aggregate(t, ["item"], [("rev", "sum")])
+            third = actor.run(run_use, timeout=60)
+            assert third[0].length == final[0].length
+            assert not any(b.pinned for b in st._unique_buffers())
+            actor.run(st.close)
+            with pytest.raises(RuntimeError):
+                st.get()
+        finally:
+            actor.done()
+
+        oracle = pdf.groupby("item").agg(s=("rev", "sum"), c=("rev", "count"))
+        got = {int(k): (s, c) for k, s, c in zip(
+            final[0].to_pylist(), final[1].to_pylist(), final[2].to_pylist())}
+        assert set(got) == set(oracle.index)
+        for item, row in oracle.iterrows():
+            s2, c = got[int(item)]
+            assert c == row.c
+            np.testing.assert_allclose(s2, row.s, rtol=1e-12)

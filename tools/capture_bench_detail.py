@@ -58,11 +58,18 @@ def run_bench(path: str, scale: float, iters: int, cpu: bool):
         line = line.strip()
         if line.startswith("{"):
             try:
-                recs.append(json.loads(line))
+                rec = json.loads(line)
             except json.JSONDecodeError:
-                pass
+                continue
+            if "bench" in rec and "ms" in rec:
+                recs.append(rec)
     if p.returncode != 0 and not recs:
         return None, p.stderr.strip()[-300:]
+    if p.returncode != 0:
+        # partial sweep: keep what measured, but mark the truncation so the
+        # table is never mistaken for a full capture
+        return recs, f"bench exited rc={p.returncode} mid-sweep: " \
+                     f"{p.stderr.strip()[-200:]}"
     return recs, None
 
 
@@ -105,11 +112,16 @@ def main(argv=None):
             lines.append(f"**capture failed:** {err}")
             lines.append("")
             continue
+        if err:
+            lines.append(f"**PARTIAL capture** — {err}")
+            lines.append("")
         lines.append("| bench | axes | ms | rows/s |")
         lines.append("|---|---|---|---|")
         for r in recs:
+            rps = r.get("rows_per_s")
+            rps = f"{rps:,}" if isinstance(rps, (int, float)) else "—"
             lines.append(f"| {r.get('bench')} | `{r.get('axes')}` | "
-                         f"{r.get('ms')} | {r.get('rows_per_s'):,} |")
+                         f"{r.get('ms')} | {rps} |")
         lines.append("")
     with open(args.out, "w") as f:
         f.write("\n".join(lines))
