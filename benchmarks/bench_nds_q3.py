@@ -1,0 +1,96 @@
+"""NDS/TPC-DS Q3-shaped end-to-end pipeline bench (BASELINE.json north
+star: NDS wall-clock parity). The physical plan a Spark executor would run
+per batch, driven entirely through the engine's public ops:
+
+    store_sales ⋈ date_dim (d_moy = 11)  ⋈ item (i_manufact_id = M)
+      → group by (d_year, i_brand_id) sum(ss_ext_sales_price as int cents)
+      → order by d_year, revenue desc
+
+Fact-table scale dominates (star-schema: dims are thousands of rows); the
+reported rows/s is over store_sales rows through the whole pipeline.
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from benchmarks.common import parse_args, run_config  # noqa: E402
+
+
+def _datagen(n_sales: int, seed=0):
+    rng = np.random.default_rng(seed)
+    n_dates, n_items = 365 * 10, 20_000         # 10 years, 20k items
+    date_sk = np.arange(n_dates, dtype=np.int64)
+    d_year = 1998 + date_sk // 365
+    d_moy = (date_sk % 365) // 31 + 1
+    item_sk = np.arange(n_items, dtype=np.int64)
+    i_brand = rng.integers(0, 1000, n_items).astype(np.int64)
+    i_manufact = rng.integers(0, 100, n_items).astype(np.int64)
+    ss = {
+        "sold_date_sk": rng.integers(0, n_dates, n_sales).astype(np.int64),
+        "item_sk": rng.integers(0, n_items, n_sales).astype(np.int64),
+        "price_cents": rng.integers(1, 10_000, n_sales).astype(np.int64),
+    }
+    return (date_sk, d_year, d_moy, item_sk, i_brand, i_manufact, ss)
+
+
+def make_column(arr):
+    import jax.numpy as jnp
+    from spark_rapids_tpu import Column, dtypes
+    return Column(dtype=dtypes.INT64, length=len(arr),
+                  data=jnp.asarray(arr))
+
+
+def build_tables(n_sales: int, seed=0):
+    from spark_rapids_tpu import Table
+    (date_sk, d_year, d_moy, item_sk, i_brand, i_manufact, ss) = \
+        _datagen(n_sales, seed)
+    col = make_column
+    sales = Table([col(ss["sold_date_sk"]), col(ss["item_sk"]),
+                   col(ss["price_cents"])],
+                  names=["sold_date_sk", "item_sk", "price_cents"])
+    dates = Table([col(date_sk), col(d_year), col(d_moy)],
+                  names=["d_date_sk", "d_year", "d_moy"])
+    items = Table([col(item_sk), col(i_brand), col(i_manufact)],
+                  names=["i_item_sk", "i_brand", "i_manufact"])
+    return sales, dates, items
+
+
+def q3(sales, dates, items):
+    """The Q3-shaped plan, shared by the bench and tests/test_nds_query.py."""
+    from spark_rapids_tpu import Table
+    from spark_rapids_tpu.ops import (apply_boolean_mask, groupby_aggregate,
+                                      inner_join, sort_table, take_table)
+    # dim filters first (the plan a CBO picks for a star join)
+    dates_f = Table([apply_boolean_mask(c, dates["d_moy"].data == 11)
+                     for c in dates.columns], names=dates.names)
+    items_f = Table([apply_boolean_mask(c, items["i_manufact"].data == 42)
+                     for c in items.columns], names=items.names)
+    lm, rm = inner_join([sales["sold_date_sk"]], [dates_f["d_date_sk"]])
+    j1 = Table(list(take_table(sales, lm.data).columns) +
+               list(take_table(dates_f, rm.data).columns),
+               names=list(sales.names) + list(dates_f.names))
+    lm2, rm2 = inner_join([j1["item_sk"]], [items_f["i_item_sk"]])
+    j2 = Table(list(take_table(j1, lm2.data).columns) +
+               list(take_table(items_f, rm2.data).columns),
+               names=list(j1.names) + list(items_f.names))
+    agg = groupby_aggregate(j2, ["d_year", "i_brand"],
+                            [("price_cents", "sum")])
+    out = Table(list(agg), names=["d_year", "i_brand", "revenue"])
+    return sort_table(out, key_names=["d_year", "revenue"],
+                      ascending=[True, False])
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    n_sales = max(int(10_000_000 * args.scale), 8192)
+    sales, dates, items = build_tables(n_sales)
+
+    run_config("nds_q3_pipeline", {"num_sales": n_sales},
+               lambda s, d, i: [c.data for c in q3(s, d, i).columns],
+               (sales, dates, items), n_rows=n_sales, iters=args.iters,
+               jit=False)   # join output sizes are data-dependent
+
+
+if __name__ == "__main__":
+    main()

@@ -7,15 +7,16 @@ sys.path.insert(0, ".")
 
 from benchmarks import (bench_bloom_filter, bench_cast_string_to_float,  # noqa: E402
                         bench_groupby, bench_join, bench_parquet_read,
-                        bench_parse_uri, bench_partition,
-                        bench_row_conversion)
+                        bench_nds_q3, bench_parse_uri,
+                        bench_partition, bench_row_conversion)
 
 
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
     for mod in (bench_row_conversion, bench_cast_string_to_float,
                 bench_bloom_filter, bench_parse_uri, bench_groupby,
-                bench_join, bench_parquet_read, bench_partition):
+                bench_join, bench_parquet_read, bench_partition,
+                bench_nds_q3):
         mod.main(argv)
 
 

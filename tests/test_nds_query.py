@@ -1,0 +1,46 @@
+"""NDS/TPC-DS Q3-shaped end-to-end correctness: the star-join → multi-key
+groupby → order-by pipeline — THE SAME `q3` plan the benchmark runs
+(imported from benchmarks/bench_nds_q3.py, so bench and test cannot
+drift) — against a pandas oracle, chained exactly the way the Spark
+plugin's physical plan would drive it (BASELINE.json north star shape)."""
+import numpy as np
+import pandas as pd
+
+import spark_rapids_tpu  # noqa: F401
+
+from benchmarks.bench_nds_q3 import _datagen, build_tables, q3
+
+
+def test_nds_q3_pipeline_matches_pandas():
+    n_sales = 30_000
+    sales, dates, items = build_tables(n_sales, seed=7)
+    out = q3(sales, dates, items)
+
+    # pandas oracle, same plan
+    (date_sk, d_year, d_moy, item_sk, i_brand, i_manufact, ss) = \
+        _datagen(n_sales, seed=7)
+    sdf = pd.DataFrame(ss)
+    ddf = pd.DataFrame({"d_date_sk": date_sk, "d_year": d_year,
+                        "d_moy": d_moy})
+    idf = pd.DataFrame({"i_item_sk": item_sk, "i_brand": i_brand,
+                        "i_manufact": i_manufact})
+    j = (sdf.merge(ddf[ddf.d_moy == 11], left_on="sold_date_sk",
+                   right_on="d_date_sk")
+            .merge(idf[idf.i_manufact == 42], left_on="item_sk",
+                   right_on="i_item_sk"))
+    ref = (j.groupby(["d_year", "i_brand"], as_index=False)
+            .agg(revenue=("price_cents", "sum"))
+            .sort_values(["d_year", "revenue"], ascending=[True, False]))
+
+    got = pd.DataFrame({
+        "d_year": out["d_year"].to_pylist(),
+        "i_brand": out["i_brand"].to_pylist(),
+        "revenue": out["revenue"].to_pylist(),
+    })
+    assert len(got) == len(ref)
+    # ties in revenue may order differently; the presentation sort must hold
+    # on (year, revenue) and the full rows must agree as multisets
+    np.testing.assert_array_equal(got.d_year.values, ref.d_year.values)
+    np.testing.assert_array_equal(got.revenue.values, ref.revenue.values)
+    assert (sorted(zip(got.d_year, got.i_brand, got.revenue)) ==
+            sorted(zip(ref.d_year, ref.i_brand, ref.revenue)))

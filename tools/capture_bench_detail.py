@@ -34,6 +34,9 @@ BENCHES = [
      "hash (VPU) + sorted-scatter bit set; scatter is the ceiling"),
     ("parse_uri", "benchmarks/bench_parse_uri.py",
      "VPU class-table lookups over padded chars"),
+    ("nds_q3", "benchmarks/bench_nds_q3.py",
+     "end-to-end star join -> multi-key groupby -> order-by; "
+     "lax.sort bound through the joins and groupby"),
     ("partition", "benchmarks/bench_partition.py",
      "A/B: sort+searchsorted vs streaming compare-reduce vs pallas "
      "histogram — the shuffle bucket-map decision"),
