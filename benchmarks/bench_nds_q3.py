@@ -61,11 +61,10 @@ def q3(sales, dates, items):
     from spark_rapids_tpu import Table
     from spark_rapids_tpu.ops import (apply_boolean_mask, groupby_aggregate,
                                       inner_join, sort_table, take_table)
-    # dim filters first (the plan a CBO picks for a star join)
-    dates_f = Table([apply_boolean_mask(c, dates["d_moy"].data == 11)
-                     for c in dates.columns], names=dates.names)
-    items_f = Table([apply_boolean_mask(c, items["i_manufact"].data == 42)
-                     for c in items.columns], names=items.names)
+    # dim filters first (the plan a CBO picks for a star join); the Table
+    # form computes the selection once for all columns
+    dates_f = apply_boolean_mask(dates, dates["d_moy"].data == 11)
+    items_f = apply_boolean_mask(items, items["i_manufact"].data == 42)
     lm, rm = inner_join([sales["sold_date_sk"]], [dates_f["d_date_sk"]])
     j1 = Table(list(take_table(sales, lm.data).columns) +
                list(take_table(dates_f, rm.data).columns),

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import sys
 import time
 from typing import Callable, Dict, Iterable, List, Sequence
 
@@ -29,7 +30,19 @@ def parse_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--scale", type=float, default=1.0,
                     help="multiply all num_rows axes by this (e.g. 0.01 for smoke)")
     ap.add_argument("--iters", type=int, default=10)
-    return ap.parse_args(argv)
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the CPU backend (CI smoke; the TPU tunnel can "
+                         "hang at init — env-var pinning is unreliable under "
+                         "the axon sitecustomize, jax.config works)")
+    args = ap.parse_args(argv)
+    if args.cpu:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            print("WARNING: --cpu could not pin the platform (backend "
+                  "already initialized); benches may hit the TPU tunnel",
+                  file=sys.stderr)
+    return args
 
 
 def run_config(bench: str, axes: Dict, fn: Callable, args, *, n_rows: int,

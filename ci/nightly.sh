@@ -6,7 +6,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 python -m pytest tests/ -q -m ""    # include the nightly-marked tier
-python benchmarks/run_all.py --scale 0.01 --iters 5
+python benchmarks/run_all.py --scale 0.01 --iters 5 --cpu
 ./ci/fuzz-test.sh
 ./ci/sanitizer.sh
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

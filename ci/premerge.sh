@@ -6,7 +6,7 @@ cd "$(dirname "$0")/.."
 
 python -c "import spark_rapids_tpu; print('import ok:', spark_rapids_tpu.__name__)"
 python -m pytest tests/ -x -q
-python benchmarks/run_all.py --scale 0.002 --iters 2
+python benchmarks/run_all.py --scale 0.002 --iters 2 --cpu
 python tools/monte_carlo.py --tasks 16 --parallelism 4 --gpu-mib 512 \
     --task-max-mib 384 --shuffle-threads 2 --seed 1
 echo "premerge OK"
