@@ -17,7 +17,7 @@ import argparse
 import json
 import sys
 import time
-from typing import Callable, Dict, Iterable, List, Sequence
+from typing import Callable, Dict, List, Sequence
 
 import numpy as np
 
@@ -36,12 +36,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                          "the axon sitecustomize, jax.config works)")
     args = ap.parse_args(argv)
     if args.cpu:
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except RuntimeError:
-            print("WARNING: --cpu could not pin the platform (backend "
-                  "already initialized); benches may hit the TPU tunnel",
-                  file=sys.stderr)
+        # a too-late pin (backend already initialized) silently no-ops, so
+        # check the outcome positively rather than catching anything
+        jax.config.update("jax_platforms", "cpu")
+        if jax.default_backend() != "cpu":
+            print(f"WARNING: --cpu could not pin the platform (backend "
+                  f"already initialized as {jax.default_backend()!r}); "
+                  f"benches may hit the TPU tunnel", file=sys.stderr)
     return args
 
 

@@ -16,7 +16,6 @@ JNI interop likewise crosses the device boundary explicitly.
 """
 from __future__ import annotations
 
-from typing import List
 
 import numpy as np
 

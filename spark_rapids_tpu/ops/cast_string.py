@@ -32,7 +32,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import dtypes
 from ..columnar import Column
 from ..dtypes import DType, Kind
 

@@ -9,7 +9,6 @@ from typing import List, Sequence, Union
 
 import jax.numpy as jnp
 
-from .. import dtypes
 from ..columnar import Column, Table
 from ..dtypes import Kind
 from .gather import take_table

@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .. import dtypes
 from ..columnar import Column
 from ..dtypes import Kind
 

@@ -10,12 +10,11 @@ non-matches.
 """
 from __future__ import annotations
 
-from typing import Sequence, Union
+from typing import Union
 
 import jax
 import jax.numpy as jnp
 
-from .. import dtypes
 from ..columnar import Column, Table
 from ..columnar.column import strings_from_padded
 from ..dtypes import Kind

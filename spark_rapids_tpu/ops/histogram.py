@@ -18,7 +18,7 @@ round-off.
 from __future__ import annotations
 
 from functools import partial
-from typing import List, Sequence
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp

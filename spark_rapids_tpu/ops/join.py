@@ -27,14 +27,13 @@ Null keys never match (Spark equi-join); null-safe equality (<=>) is the
 from __future__ import annotations
 
 from functools import partial
-from typing import List, Optional, Sequence, Tuple, Union
+from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from .. import dtypes
 from ..columnar import Column, Table
-from ..dtypes import Kind
 from .sort import _key_operands
 
 __all__ = ["inner_join", "left_join", "left_semi_join", "left_anti_join"]

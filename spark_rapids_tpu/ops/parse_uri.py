@@ -20,13 +20,11 @@ correlation over pair-start positions rather than a split loop.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import dtypes
 from ..columnar.column import Column, _round_bucket, strings_from_padded
 
 # ---------------------------------------------------------------------------

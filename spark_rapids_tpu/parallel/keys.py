@@ -42,7 +42,6 @@ from typing import List, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from .. import dtypes
 from ..columnar.column import Column, strings_from_padded
 from ..dtypes import DType, Kind
 

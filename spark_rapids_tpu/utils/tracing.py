@@ -16,8 +16,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
-import os
-from typing import Callable, Optional, TypeVar
+from typing import Callable, TypeVar
 
 F = TypeVar("F", bound=Callable)
 

@@ -17,7 +17,7 @@ import pytest
 
 import jax.numpy as jnp
 
-from spark_rapids_tpu import Column, Table, dtypes
+from spark_rapids_tpu import Column, Table
 from spark_rapids_tpu.ops import (concat_tables, groupby_aggregate,
                                   halve_table, murmur_hash3_32)
 from spark_rapids_tpu.runtime import (DeviceSession, RetryOOM, SpillPool,

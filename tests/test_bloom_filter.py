@@ -7,7 +7,7 @@ import pytest
 from spark_rapids_tpu import dtypes
 from spark_rapids_tpu.columnar import Column
 from spark_rapids_tpu.ops.bloom_filter import (
-    BloomFilter, bloom_filter_create, bloom_filter_put, bloom_filter_merge,
+    bloom_filter_create, bloom_filter_put, bloom_filter_merge,
     bloom_filter_probe, bloom_filter_serialize, bloom_filter_deserialize)
 
 from spark_hash_oracle import murmur32_bytes, encode_int8

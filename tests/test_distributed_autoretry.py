@@ -13,7 +13,6 @@ import spark_rapids_tpu  # noqa: F401
 from spark_rapids_tpu.parallel import (CapacityOverflowError,
                                        auto_retry_overflow,
                                        distributed_groupby,
-                                       distributed_groupby_auto,
                                        distributed_inner_join_auto,
                                        distributed_sort_auto, make_mesh)
 

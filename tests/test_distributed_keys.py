@@ -11,8 +11,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import spark_rapids_tpu  # noqa: F401
-from spark_rapids_tpu import Column, Table, dtypes
-from spark_rapids_tpu.ops import groupby_aggregate, murmur_hash3_32
+from spark_rapids_tpu import Column, dtypes
+from spark_rapids_tpu.ops import murmur_hash3_32
 from spark_rapids_tpu.parallel import (decode_key_columns,
                                        distributed_groupby_keyed,
                                        distributed_inner_join_keyed,

@@ -9,7 +9,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import spark_rapids_tpu  # noqa: F401
-from spark_rapids_tpu import Column, Table, dtypes
+from spark_rapids_tpu import Column, Table
 from spark_rapids_tpu.ops import groupby_aggregate, inner_join
 from spark_rapids_tpu.parallel import (distributed_groupby,
                                        distributed_inner_join, make_mesh)

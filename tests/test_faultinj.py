@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 
 import spark_rapids_tpu  # noqa: F401  (x64 mode)
-from spark_rapids_tpu import Column, dtypes, faultinj
+from spark_rapids_tpu import Column, faultinj
 from spark_rapids_tpu.faultinj import (DeviceAssertError, DeviceFatalError,
                                        InjectedReturnCode)
 

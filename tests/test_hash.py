@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 
 from spark_rapids_tpu import dtypes
-from spark_rapids_tpu.columnar import Column, Table
+from spark_rapids_tpu.columnar import Column
 from spark_rapids_tpu.ops.hash import murmur_hash3_32, xxhash64
 
 import spark_hash_oracle as oracle

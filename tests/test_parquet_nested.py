@@ -7,7 +7,6 @@ import io
 import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
-import pytest
 
 import spark_rapids_tpu  # noqa: F401
 from spark_rapids_tpu.io import read_parquet

@@ -13,9 +13,9 @@ import queue
 import pytest
 
 from spark_rapids_tpu.runtime import (
-    ResourceArbiter, DeviceSession, MemoryBudget, MemoryEventHandler,
+    DeviceSession, MemoryEventHandler,
     OomInjectionType,
-    RetryOOM, SplitAndRetryOOM, CpuRetryOOM, CpuSplitAndRetryOOM,
+    RetryOOM, SplitAndRetryOOM, CpuRetryOOM,
     HardOOM, InjectedException, with_retry,
     STATE_RUNNING, STATE_BLOCKED, STATE_BUFN, STATE_BUFN_WAIT,
 )
