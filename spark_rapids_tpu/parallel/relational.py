@@ -278,51 +278,84 @@ def distributed_sort(mesh: Mesh, keys: jnp.ndarray, vals: jnp.ndarray,
     4. local sort of the received rows
 
     Returns per-shard (keys, vals, valid, overflow); overflow means a shard
-    received more than cap rows (skewed keys) — retry with bigger slack."""
+    received more than cap rows (skewed keys) — retry with bigger slack.
+
+    The single-int64-key case of distributed_sort_keyed (one word, no
+    specs), kept as the plain-array front door."""
+    (w,), ov, valid, overflow = distributed_sort_keyed(
+        mesh, [keys], None, vals, slack=slack, axis=axis)
+    return w, ov, valid, overflow
+
+
+def distributed_sort_keyed(mesh: Mesh, key_words: Sequence[jnp.ndarray],
+                           key_specs, vals: jnp.ndarray, slack: float = 2.0,
+                           axis: str = "data"):
+    """Global sort over typed keys (word lists from keys.encode_key_columns,
+    so string/decimal128/float/nullable keys all sort) — sample-sort as one
+    jitted SPMD program, the multi-word generalization of distributed_sort.
+    The word encoding is order-preserving (tuple lexicographic order == the
+    column's sort order, nulls first), so splitters are word TUPLES and the
+    partition id is a vectorized lexicographic rank against them.
+
+    `key_specs` is accepted for API symmetry with the other keyed ops and
+    for the caller's later decode; the sort itself needs only the
+    order-preserving words (pass None when sorting raw arrays).
+
+    Returns per-shard ([key words], vals, valid, overflow); shard 0 ends
+    with the smallest keys. overflow means a shard received more than its
+    slack-sized capacity (skewed keys) — retry with bigger slack."""
+    del key_specs  # symmetry/decode-side only
     n_peers = mesh.shape[axis]
+    key_words = list(key_words)
+    nw = len(key_words)
 
-    def local(k, v):
-        nloc = k.shape[0]
-        # per-destination bucket capacity: splitters balance destinations to
-        # ~nloc/P rows each; slack absorbs sampling error and key skew
+    def local(*arrs):
+        ws, v = list(arrs[:nw]), arrs[nw]
+        nloc = ws[0].shape[0]
         cap = max(1, math.ceil(nloc / n_peers * slack))
-        sk, order = jax.lax.sort([k, jnp.arange(nloc, dtype=jnp.int32)],
-                                 num_keys=1, is_stable=True)
+        iota = jnp.arange(nloc, dtype=jnp.int32)
+        out = jax.lax.sort([*ws, iota], num_keys=nw, is_stable=True)
+        sws, order = list(out[:-1]), out[-1]
         sv = jnp.take(v, order, axis=0)
-        # P-1 evenly spaced local samples of the sorted run
+        # P-1 evenly spaced local sample TUPLES from the sorted run
         pos = (jnp.arange(1, n_peers, dtype=jnp.int32) * nloc) // n_peers
-        samples = jnp.take(sk, pos, axis=0, mode="clip")
-        pool = jax.lax.all_gather(samples, axis).reshape(-1)    # (P*(P-1),)
-        pool = jax.lax.sort([pool], num_keys=1)[0]
-        m = pool.shape[0]
+        pools = []
+        for w in sws:
+            samples = jnp.take(w, pos, axis=0, mode="clip")
+            pools.append(jax.lax.all_gather(samples, axis).reshape(-1))
+        pool_sorted = jax.lax.sort(pools, num_keys=nw, is_stable=True)
+        m = pool_sorted[0].shape[0]
         spl_pos = (jnp.arange(1, n_peers, dtype=jnp.int32) * m) // n_peers
-        splitters = jnp.take(pool, spl_pos, axis=0, mode="clip")  # (P-1,)
+        spl = [jnp.take(p, spl_pos, axis=0, mode="clip")
+               for p in pool_sorted]                       # W x (P-1,)
 
-        # partition id = number of splitters < key (rows sorted, so the
-        # comparison is a tiny (n, P-1) broadcast, not a search)
-        part = jnp.sum(sk[:, None] > splitters[None, :],
-                       axis=1).astype(jnp.int32)
-        (rk, rv), ralive, spilled = _bucket_exchange(
-            axis, n_peers, cap, part, [(sk, _DEAD_KEY), (sv, 0)])
-        # a spill anywhere means some shard's output is incomplete: agree on
-        # the flag across the mesh so every caller sees it
+        # partition id = #splitters strictly below the row tuple:
+        # lexicographic splitter<row over words, vectorized (n, P-1)
+        lt = jnp.zeros((nloc, n_peers - 1), bool)
+        eq = jnp.ones((nloc, n_peers - 1), bool)
+        for w, s in zip(sws, spl):
+            lt = lt | (eq & (s[None, :] < w[:, None]))
+            eq = eq & (s[None, :] == w[:, None])
+        # strict splitter<row mirrors distributed_sort's `row > splitter`:
+        # rows equal to a splitter stay in the lower bucket
+        part = jnp.sum(lt, axis=1).astype(jnp.int32)
+        recv, ralive_, spilled = _bucket_exchange(
+            axis, n_peers, cap, part,
+            [(w, _DEAD_KEY) for w in sws] + [(sv, 0)])
         spilled = jax.lax.all_gather(spilled.reshape(1), axis).any()
-
-        # final local sort; dead slots carry the sentinel and sink to the end
-        key2 = jnp.where(ralive, rk, _DEAD_KEY)
-        ok, oa, ov = jax.lax.sort(
-            [key2, jnp.where(ralive, jnp.int32(0), jnp.int32(1)), rv],
-            num_keys=2, is_stable=True)
-        return ok, ov, oa == 0, spilled.reshape(1)
+        rws, rv = recv[:nw], recv[nw]
+        # final local sort; dead slots carry the sentinel and sink last
+        dead_flag = jnp.where(ralive_, jnp.int32(0), jnp.int32(1))
+        keyed = [jnp.where(ralive_, w, _DEAD_KEY) for w in rws]
+        out2 = jax.lax.sort([*keyed, dead_flag, rv], num_keys=nw + 1,
+                            is_stable=True)
+        return (tuple(out2[:nw]), out2[-1], out2[nw] == 0,
+                spilled.reshape(1))
 
     spec = P(axis)
-    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec),
-                   out_specs=(spec,) * 4)
-    return fn(keys, vals)
-
-
-def _as_list(x):
-    return list(x) if isinstance(x, (list, tuple)) else [x]
+    fn = shard_map(local, mesh=mesh, in_specs=(spec,) * (nw + 1),
+                   out_specs=(tuple(spec for _ in key_words),) + (spec,) * 3)
+    return fn(*key_words, vals)
 
 
 def _local_join_tail(lk, lv, lalive, rk, rv, ralive, row_cap: int,
