@@ -358,6 +358,10 @@ def distributed_sort_keyed(mesh: Mesh, key_words: Sequence[jnp.ndarray],
     return fn(*key_words, vals)
 
 
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
 def _local_join_tail(lk, lv, lalive, rk, rv, ralive, row_cap: int,
                      outer: bool = False, lmatch=None, rmatch=None):
     """Shard-local (inner or left-outer) join into a fixed row_cap: union
