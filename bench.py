@@ -32,15 +32,16 @@ DEVICE_TIMEOUT_S = 300
 RETRY_SLEEP_S = 15
 
 
-def _bench(fn, args, iters):
-    import jax
+def _bench(fn, args, iters, platform):
+    """Steady-state seconds/iter on a device of the given platform; the
+    barrier + differencing methodology lives in benchmarks.common (the
+    tunnel's block_until_ready is not a reliable barrier — see
+    `benchmarks.common.sync`/`steady_state_ms`)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.common import steady_state_ms, sync
     out = fn(*args)           # warmup/compile
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+    sync(out)
+    return steady_state_ms(fn, args, iters, platform) / 1e3
 
 
 def measure(force_cpu: bool) -> None:
@@ -78,7 +79,8 @@ def measure(force_cpu: bool) -> None:
     dev = jax.devices("cpu")[0] if force_cpu else jax.devices()[0]
     d_args = (jax.device_put(jnp.asarray(keys_np), dev),
               jax.device_put(jnp.asarray(vals_np), dev))
-    dev_s = _bench(jit_step, d_args, iters=20 if dev.platform != "cpu" else 5)
+    dev_s = _bench(jit_step, d_args, iters=20 if dev.platform != "cpu" else 5,
+                   platform=dev.platform)
     dev_rows_per_s = n / dev_s
 
     vs_baseline = None
@@ -87,7 +89,7 @@ def measure(force_cpu: bool) -> None:
             cpu = jax.devices("cpu")[0]
             c_args = (jax.device_put(jnp.asarray(keys_np), cpu),
                       jax.device_put(jnp.asarray(vals_np), cpu))
-            cpu_s = _bench(jit_step, c_args, iters=3)
+            cpu_s = _bench(jit_step, c_args, iters=3, platform="cpu")
             vs_baseline = round(dev_rows_per_s / (n / cpu_s), 3)
         except Exception:
             vs_baseline = None  # baseline did not run; distinct from 1.0
