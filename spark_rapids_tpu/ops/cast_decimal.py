@@ -5,8 +5,9 @@ Re-design of the reference's two-pass decimal parser
 cast_string.cu:376-599): the reference marches one CUDA thread per row; here
 the structural validation is boolean-matrix algebra over the padded char
 matrix, the digit/significance bookkeeping is exclusive prefix sums, and the
-value itself is built by one masked scan using 256-bit limb arithmetic
-(decimal256.py) so DECIMAL128 needs no native int128.
+value itself is a closed-form positional-weight multiply-reduce into 256-bit
+limbs (per-limb u64 sums + one carry propagation, decimal256.py) so
+DECIMAL128 needs no native int128 and no per-character sequential loop.
 
 Semantics preserved:
 - grammar ws* sign? digits* ('.' digits*)? ([eE] sign? digits*)? ws* with the
@@ -39,7 +40,8 @@ from .. import dtypes
 from ..columnar import Column
 from ..dtypes import Kind
 from . import decimal256 as d256
-from .cast_string import CastError, _char_at, _first_idx, _is_ws, _raise_first_error
+from .cast_string import (CastError, _POW10_U64, _char_at, _first_idx, _is_ws,
+                          _raise_first_error)
 
 _BOUNDS = {
     Kind.DECIMAL32: (2**31 - 1, 2**31),
@@ -125,26 +127,26 @@ def string_to_decimal(col: Column, precision: int, scale: int,
     # DECIMAL128 (documented deviation in the module docstring)
     emax = min(tmax_pos, 2**63 - 1)
     emin = -min(tmax_negmag, 2**63)
-    emax_d10 = emax // 10
-    emin_d10 = -((-emin) // 10)  # C truncation toward zero
 
-    def estep(p, carry):
-        ev, eok = carry
-        c = jax.lax.dynamic_slice_in_dim(C, p, 1, axis=1)[:, 0]
-        d = (c - 48).astype(jnp.int64)
-        active = jax.lax.dynamic_slice_in_dim(exp_region, p, 1, axis=1)[:, 0] & \
-            ((c >= 48) & (c <= 57))
-        of_mul = jnp.where(exp_positive, ev > emax_d10, ev < emin_d10)
-        ev10 = ev * 10
-        of_add = jnp.where(exp_positive, ev10 > emax - d, ev10 < emin + d)
-        evn = jnp.where(exp_positive, ev10 + d, ev10 - d)
-        of = (of_mul | of_add) & active
-        ev = jnp.where(active & ~of, evn, ev)
-        return ev, eok & ~of
-
-    exp_val, exp_ok = jax.lax.fori_loop(
-        0, L, estep, (jnp.zeros((n,), jnp.int64), jnp.ones((n,), jnp.bool_)))
-    valid &= exp_ok
+    # Closed-form exponent accumulation (replaces an L-step sequential loop):
+    # appending a digit never shrinks the magnitude, so the loop's per-step
+    # overflow checks fire iff the final magnitude exceeds the bound. Weight
+    # each exponent digit by 10^(digits-to-its-right), reduce in u64 (exact
+    # once >19-significant-digit rows — which always exceed any bound here —
+    # are flagged), then compare against the bound once. Rows already invalid
+    # from the structural checks may compute garbage; their validity is false.
+    d_u = jnp.clip(C - 48, 0, 9).astype(jnp.uint64)
+    em = exp_region & digit
+    erfr = jnp.sum(em, axis=1)[:, None] - jnp.cumsum(em, axis=1)  # digits right
+    enz = em & (C != 48)
+    e_nd_eff = jnp.max(jnp.where(enz, erfr + 1, 0), axis=1)
+    wE = jnp.take(jnp.asarray(_POW10_U64), jnp.clip(erfr, 0, 19))
+    emag = jnp.sum(jnp.where(em, d_u * wE, jnp.uint64(0)), axis=1)
+    eof = (e_nd_eff > 19) | jnp.where(exp_positive, emag > jnp.uint64(emax),
+                                      emag > jnp.uint64(-emin))
+    valid &= ~eof
+    exp_val = jax.lax.bitcast_convert_type(
+        jnp.where(exp_positive, emag, jnp.uint64(0) - emag), jnp.int64)
 
     # ---- decimal location -----------------------------------------------------
     # chars-from-istart index of the '.', or the mantissa digit count
@@ -186,19 +188,31 @@ def string_to_decimal(col: Column, precision: int, scale: int,
     bnd = jnp.where(positive[:, None], jnp.broadcast_to(bound, (n, 8)),
                     jnp.broadcast_to(bound_neg, (n, 8)))
 
-    def vstep(p, carry):
-        mag, vok = carry
-        c = jax.lax.dynamic_slice_in_dim(C, p, 1, axis=1)[:, 0]
-        d = (c - 48).astype(jnp.uint64)
-        active = jax.lax.dynamic_slice_in_dim(accumulate, p, 1, axis=1)[:, 0]
-        mag_new = d256.add_small(d256.mul_small(mag, jnp.uint64(10)), d)
-        of = d256.lt_unsigned(bnd, mag_new) & active
-        mag = jnp.where((active & ~of)[:, None], mag_new, mag)
-        return mag, vok & ~of
-
-    mag, vok = jax.lax.fori_loop(
-        0, L, vstep, (jnp.zeros((n, 8), jnp.uint64), jnp.ones((n,), jnp.bool_)))
-    valid &= vok
+    # Closed-form 256-bit value accumulation (replaces an L-step sequential
+    # loop of limb multiply-adds). Weight each accumulated digit by
+    # 10^(accumulated-digits-to-its-right) — any NONZERO accumulated digit
+    # has at most 38 significant accumulated digits to its right (np_before
+    # < precision bounds them), so clipping the weight index at 39 only ever
+    # affects zero digits. Per limb j: sum d * limb_j(10^k) over the row in
+    # u64 — each term < 9*2^32 and L terms can't wrap u64 — then one 8-step
+    # carry propagation normalizes back to u32 limbs. Exact, since the true
+    # value < 10^39 < 2^256. The loop's per-step overflow check fires iff
+    # the final magnitude exceeds the bound (appending digits only grows
+    # it), so one final compare replaces it.
+    acc_i32 = accumulate.astype(jnp.int32)
+    vrfr = jnp.sum(acc_i32, axis=1)[:, None] - jnp.cumsum(acc_i32, axis=1)
+    widx = jnp.clip(vrfr, 0, 39)
+    tblW = d256.pow10_table()                       # (77, 8) u32-in-u64 limbs
+    c_carry = jnp.zeros((n,), jnp.uint64)
+    mag_limbs = []
+    for j in range(8):
+        Wj = jnp.take(tblW[:, j], widx)
+        s = jnp.sum(jnp.where(accumulate, d_u * Wj, jnp.uint64(0)), axis=1)
+        t = s + c_carry
+        mag_limbs.append(t & jnp.uint64(0xFFFFFFFF))
+        c_carry = t >> jnp.uint64(32)
+    mag = jnp.stack(mag_limbs, axis=1)
+    valid &= ~d256.lt_unsigned(bnd, mag)
 
     # ---- HALF_UP rounding with carry-digit detection -------------------------
     do_round = has_round & (round_digit >= 5)

@@ -4,8 +4,10 @@ Re-design of the reference's cast kernels (cast_string.cu:158-244 string→int,
 cast_string_to_float.cu:56-653 string→float, CastStringJni.cpp:159-258 base
 conversions) for the XLA substrate: the reference marches one CUDA thread (or
 warp) per row over the chars; here every rule is a dense boolean-matrix
-computation over the padded (rows, max_len) char matrix plus one masked scan
-for digit accumulation.
+computation over the padded (rows, max_len) char matrix, and digit
+accumulation is a closed-form positional-weight multiply-reduce (each digit
+times 10^rank-from-the-right in u64) rather than a sequential loop — one
+fused XLA pass over the matrix instead of max_len dependent steps.
 
 Spark semantics preserved:
 - whitespace = {space, \\r, \\t, \\n} only (cast_string.cu:46-56);
@@ -71,6 +73,19 @@ def _char_at(C, idx):
     return jnp.take_along_axis(C, jnp.clip(idx, 0, L - 1)[:, None], axis=1)[:, 0]
 
 
+def _rank_in_mask(mask):
+    """Exclusive per-row running count of True positions in an (n, L) mask:
+    rank[i, j] = number of True entries strictly left of j in row i."""
+    c = jnp.cumsum(mask, axis=1, dtype=jnp.int32)
+    return c - mask.astype(jnp.int32)
+
+
+# 10^k as u64 for k in [0, 19] (10^19 < 2^64); jnp.take per (n, L) exponent
+# plane gives each digit its positional weight so a whole row's magnitude is
+# one masked multiply-reduce instead of an L-step sequential accumulator
+_POW10_U64 = np.array([10**k for k in range(20)], dtype=np.uint64)
+
+
 def _raise_first_error(col: Column, error_mask):
     """ANSI contract: raise for the first flagged row with its content
     (validate_ansi_column, cast_string.cu:601-634)."""
@@ -89,8 +104,6 @@ def string_to_integer(col: Column, out_type: DType, ansi_mode: bool = False,
     """
     assert out_type.kind in _INT_LIMITS, f"not an integer type: {out_type}"
     tmin, tmax = _INT_LIMITS[out_type.kind]
-    tmax_d10 = tmax // 10
-    tmin_d10 = -((-tmin) // 10)  # C truncation toward zero
 
     padded, lens = col.padded_chars(pad_to)
     C = padded.astype(jnp.int32)
@@ -143,25 +156,29 @@ def string_to_integer(col: Column, out_type: DType, ansi_mode: bool = False,
 
     dend = jnp.minimum(jnp.minimum(first_dot, fw), lens)
 
-    adding = ~neg
-
-    def step(p, carry):
-        val, ok = carry
-        c = jax.lax.dynamic_slice_in_dim(C, p, 1, axis=1)[:, 0]
-        d = (c - 48).astype(jnp.int64)
-        active = (p >= istart) & (p < dend)
-        first = p == istart
-        mul_of = jnp.where(adding, val > tmax_d10, val < tmin_d10) & ~first
-        val2 = jnp.where(first, val, val * 10)
-        add_of = jnp.where(adding, val2 > tmax - d, val2 < tmin + d)
-        of = (mul_of | add_of) & active
-        val3 = jnp.where(adding, val2 + d, val2 - d)
-        val = jnp.where(active & ~of, val3, val)
-        return val, ok & ~of
-
-    val, ok = jax.lax.fori_loop(
-        0, L, step, (jnp.zeros((n,), jnp.int64), jnp.ones((n,), jnp.bool_)))
-    valid &= ok
+    # Closed-form digit accumulation (replaces an L-step sequential loop):
+    # appending a digit never shrinks the magnitude, so the reference's
+    # per-step overflow checks (cast_string.cu:100-143) fire iff the final
+    # magnitude exceeds the type bound. Give each digit its positional
+    # weight 10^(dend-1-pos) and reduce — exact in u64 once rows with more
+    # than 19 significant digits (which always overflow every int type) are
+    # flagged up front. Rows already invalid from the region checks may
+    # compute garbage here; their validity is already false.
+    dig_run = (pos >= istart[:, None]) & (pos < dend[:, None])
+    nzrun = dig_run & (C != 48)
+    first_nz = _first_idx(nzrun, 0)
+    first_nz = jnp.where(jnp.any(nzrun, axis=1), first_nz, dend)
+    nd_eff = dend - first_nz                  # digits after leading zeros
+    e = dend[:, None] - 1 - pos
+    w = jnp.take(jnp.asarray(_POW10_U64), jnp.clip(e, 0, 19))
+    d_u = jnp.clip(C - 48, 0, 9).astype(jnp.uint64)
+    dmask = dig_run & (pos >= first_nz[:, None])
+    mag = jnp.sum(jnp.where(dmask, d_u * w, jnp.uint64(0)), axis=1)
+    of = (nd_eff > 19) | jnp.where(neg, mag > jnp.uint64(-tmin),
+                                   mag > jnp.uint64(tmax))
+    valid &= ~of
+    val = jax.lax.bitcast_convert_type(
+        jnp.where(neg, jnp.uint64(0) - mag, mag), jnp.int64)
 
     out = Column(dtype=out_type, length=n,
                  data=val.astype(out_type.storage_dtype()),
@@ -278,26 +295,26 @@ def string_to_float(col: Column, out_type: DType, ansi_mode: bool = False,
     # mask of counted digit positions: digits in [first_nz, term) excluding dot
     counted = (pos >= first_nz[:, None]) & (pos < term[:, None]) & digit
 
-    def dstep(p, carry):
-        dval, cnt, blocked = carry
-        c = jax.lax.dynamic_slice_in_dim(C, p, 1, axis=1)[:, 0]
-        d = (c - 48).astype(jnp.uint64)
-        active = jax.lax.dynamic_slice_in_dim(counted, p, 1, axis=1)[:, 0]
-        can19 = cnt < 19
-        # dval <= max_holding guard first: dval*10+d cannot wrap then
-        # (mirrors the check order at cast_string_to_float.cu:404-427)
-        extra_ok = (cnt == 19) & ~blocked & (dval <= jnp.uint64(_MAX_HOLDING)) & \
-            (dval * jnp.uint64(10) + d <= jnp.uint64(_MAX_HOLDING))
-        # once the 20th digit fails to fit, everything after truncates
-        blocked = blocked | (active & (cnt == 19) & ~extra_ok)
-        absorb = active & (can19 | extra_ok)
-        dval = jnp.where(absorb, dval * jnp.uint64(10) + d, dval)
-        cnt = cnt + jnp.where(absorb, 1, 0)
-        return dval, cnt, blocked
-
-    dval, absorbed, _ = jax.lax.fori_loop(
-        0, L, dstep, (jnp.zeros((n,), jnp.uint64), jnp.zeros((n,), jnp.int32),
-                      jnp.zeros((n,), jnp.bool_)))
+    # Closed form (replaces an L-step sequential accumulator): the loop
+    # absorbs exactly min(total, 19) digits unconditionally, then at most ONE
+    # guarded 20th (after a 20th digit the count passes 19 and nothing more
+    # can ever absorb). So rank every counted digit, weight the first k19 by
+    # 10^(k19-1-rank), reduce in u64 (k19 <= 19 keeps it exact), and apply
+    # the single 20th-digit guard (check order of cast_string_to_float.cu:
+    # 404-427: the <= max_holding test precedes the multiply so it can't wrap).
+    r = _rank_in_mask(counted)
+    total_counted = jnp.sum(counted, axis=1).astype(jnp.int32)
+    k19 = jnp.minimum(total_counted, 19)
+    e19 = k19[:, None] - 1 - r
+    w19 = jnp.take(jnp.asarray(_POW10_U64), jnp.clip(e19, 0, 19))
+    d_u = jnp.clip(C - 48, 0, 9).astype(jnp.uint64)
+    take19 = counted & (r < k19[:, None])
+    dval19 = jnp.sum(jnp.where(take19, d_u * w19, jnp.uint64(0)), axis=1)
+    d20 = jnp.sum(jnp.where(counted & (r == 19), d_u, jnp.uint64(0)), axis=1)
+    extra_ok = (total_counted >= 20) & (dval19 <= jnp.uint64(_MAX_HOLDING)) & \
+        (dval19 * jnp.uint64(10) + d20 <= jnp.uint64(_MAX_HOLDING))
+    dval = jnp.where(extra_ok, dval19 * jnp.uint64(10) + d20, dval19)
+    absorbed = k19 + extra_ok.astype(jnp.int32)
     truncated = total_digits - absorbed
     exp_base = truncated - jnp.where(has_dot, total_digits - a1, 0)
 
@@ -419,15 +436,19 @@ def string_to_integer_with_base(col: Column, out_type: DType, base: int = 10,
     run_end = jnp.where(jnp.any(non_dig_in_run, axis=1), run_end, lens)
     matched = run_end > istart  # at least one digit after optional sign
 
-    mul = jnp.int64(base)
-
-    def step(p, val):
-        d = jax.lax.dynamic_slice_in_dim(dval, p, 1, axis=1)[:, 0].astype(jnp.int64)
-        active = (p >= istart) & (p < run_end)
-        return jnp.where(active, val * mul + d, val)
-
-    val = jax.lax.fori_loop(0, L, step, jnp.zeros((n,), jnp.int64))
-    val = jnp.where(neg, -val, val)
+    # Closed form mod 2^64 (conv arithmetic wraps): weight each digit by
+    # base^(run_end-1-pos) mod 2^64 — the wrapped power table is computed
+    # host-side with exact bigints, so the masked multiply-reduce matches the
+    # sequential val*base+d chain bit for bit.
+    btbl = jnp.asarray(np.array([pow(base, k, 2**64) for k in range(max(L, 1))],
+                                dtype=np.uint64))
+    eb = run_end[:, None] - 1 - pos
+    wb = jnp.take(btbl, jnp.clip(eb, 0, L - 1))
+    brun = (pos >= istart[:, None]) & (pos < run_end[:, None])
+    mag = jnp.sum(jnp.where(brun, dval.astype(jnp.uint64) * wb, jnp.uint64(0)),
+                  axis=1)
+    val = jax.lax.bitcast_convert_type(
+        jnp.where(neg, jnp.uint64(0) - mag, mag), jnp.int64)
     val = jnp.where(matched, val, 0)
     validity = col.null_mask & ~all_ws & (lens > 0)
     return Column(dtype=out_type, length=n,
