@@ -1,0 +1,114 @@
+"""NDS/TPC-DS Q23-shaped end-to-end pipeline (BASELINE.json configs[4]).
+Q23 is the *subquery-reuse* query: two expensive subqueries — frequent
+items (groupby-HAVING over store_sales) and best customers (per-customer
+revenue over a MAX scalar threshold) — are computed once and applied as
+IN-filters (semi joins) to BOTH catalog_sales and web_sales, whose filtered
+revenues are unioned and totaled.
+
+Shape exercised (all public ops):
+    freq_items  = groupby(store_sales, item) count  HAVING count > T
+    best_cust   = groupby(store_sales, cust) sum    HAVING sum > 0.95*MAX
+    for side in (catalog, web):
+        side ⋉ freq_items ⋉ best_cust → sum(qty*price)
+    total = sum of both sides                           (one-row output)
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from benchmarks.common import parse_args, run_config  # noqa: E402
+
+FREQ_THRESHOLD = 4
+BEST_FRACTION = 0.95
+
+
+def _datagen(n_sales: int, seed=0):
+    rng = np.random.default_rng(seed)
+    n_items, n_cust = 2_000, 5_000
+    # zipf-ish skew so HAVING clauses select non-trivial subsets
+    items = (rng.zipf(1.3, n_sales) % n_items).astype(np.int64)
+    custs = (rng.zipf(1.2, n_sales) % n_cust).astype(np.int64)
+    store = {"item_sk": items, "cust_sk": custs,
+             "qty": rng.integers(1, 10, n_sales).astype(np.int64),
+             "price": rng.integers(1, 1000, n_sales).astype(np.int64)}
+    sides = {}
+    for name, frac in (("catalog", 2), ("web", 4)):
+        m = max(n_sales // frac, 16)
+        sides[name] = {
+            "item_sk": (rng.zipf(1.3, m) % n_items).astype(np.int64),
+            "cust_sk": (rng.zipf(1.2, m) % n_cust).astype(np.int64),
+            "qty": rng.integers(1, 10, m).astype(np.int64),
+            "price": rng.integers(1, 1000, m).astype(np.int64)}
+    return store, sides
+
+
+def _col(arr):
+    import jax.numpy as jnp
+    from spark_rapids_tpu import Column, dtypes
+    return Column(dtype=dtypes.INT64, length=len(arr), data=jnp.asarray(arr))
+
+
+def _tab(d):
+    from spark_rapids_tpu import Table
+    return Table([_col(v) for v in d.values()], names=list(d.keys()))
+
+
+def build_tables(n_sales: int, seed=0):
+    store, sides = _datagen(n_sales, seed)
+    return _tab(store), {k: _tab(v) for k, v in sides.items()}
+
+
+def q23(store, sides):
+    """The Q23-shaped plan, shared by bench and tests/test_nds_query.py."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu import Table
+    from spark_rapids_tpu.ops import (apply_boolean_mask, groupby_aggregate,
+                                      left_semi_join, take_table)
+
+    # subquery 1: frequent items (computed ONCE, used on both sides)
+    by_item = groupby_aggregate(store, ["item_sk"], [("qty", "count")])
+    freq = Table(list(by_item), names=["item_sk", "cnt"])
+    freq = apply_boolean_mask(freq, freq["cnt"].data > FREQ_THRESHOLD)
+
+    # subquery 2: best customers — revenue above 95% of the max revenue
+    rev = store["qty"].data * store["price"].data
+    store2 = Table(list(store.columns) + [_col_from(rev)],
+                   names=list(store.names) + ["rev"])
+    by_cust = groupby_aggregate(store2, ["cust_sk"], [("rev", "sum")])
+    best = Table(list(by_cust), names=["cust_sk", "rev"])
+    max_rev = jnp.max(best["rev"].data)          # the MAX scalar subquery
+    best = apply_boolean_mask(
+        best, best["rev"].data.astype(jnp.float64) >
+              BEST_FRACTION * max_rev.astype(jnp.float64))
+
+    totals = []
+    for side in sides.values():
+        keep = left_semi_join([side["item_sk"]], [freq["item_sk"]])
+        s1 = take_table(side, keep.data)
+        keep2 = left_semi_join([s1["cust_sk"]], [best["cust_sk"]])
+        s2 = take_table(s1, keep2.data)
+        totals.append(jnp.sum(s2["qty"].data * s2["price"].data))
+    return totals[0] + totals[1]          # (1,)-free scalar jax.Array
+
+
+def _col_from(data):
+    from spark_rapids_tpu import Column, dtypes
+    return Column(dtype=dtypes.INT64, length=data.shape[0], data=data)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    n_sales = max(int(10_000_000 * args.scale), 8192)
+    store, sides = build_tables(n_sales)
+    n_total = store.num_rows + sum(t.num_rows for t in sides.values())
+
+    run_config("nds_q23_pipeline", {"num_rows": n_total},
+               lambda s, c, w: q23(s, {"catalog": c, "web": w}),
+               (store, sides["catalog"], sides["web"]),
+               n_rows=n_total, iters=args.iters,
+               jit=False)   # semi-join output sizes are data-dependent
+
+
+if __name__ == "__main__":
+    main()

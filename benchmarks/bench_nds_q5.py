@@ -1,0 +1,145 @@
+"""NDS/TPC-DS Q5-shaped end-to-end pipeline (BASELINE.json configs[4]:
+"NDS SF100 q5/q23/q72"). Q5 is the *multi-channel rollup*: per channel
+(store / catalog / web), sales and returns are UNIONed into one relation,
+joined to a date window, aggregated per channel entity, then the three
+channels are unioned and rolled up (channel subtotal + grand total).
+
+The shape exercised here (all through public ops, like bench_nds_q3):
+    3 x [ concat(sales-as-rows, returns-as-rows) ⋈ date_dim(window)
+          → groupby entity_sk: sum(sales), sum(returns), sum(profit) ]
+    → add channel tag → concat → groupby (channel) rollup
+    → grand-total concat → order by channel, sales desc
+
+Reported rows/s is over total input rows (sales + returns, all channels).
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from benchmarks.common import parse_args, run_config  # noqa: E402
+
+
+def _datagen(n_sales: int, seed=0):
+    """Three channels; returns are ~10% of sales volume."""
+    rng = np.random.default_rng(seed)
+    n_dates = 365 * 5
+    chans = {}
+    for ci, name in enumerate(("store", "catalog", "web")):
+        n_s = n_sales // (ci + 1)           # store biggest, web smallest
+        n_r = max(n_s // 10, 1)
+        chans[name] = {
+            "s_sk": rng.integers(0, 1000, n_s).astype(np.int64),
+            "s_date": rng.integers(0, n_dates, n_s).astype(np.int64),
+            "s_price": rng.integers(1, 10_000, n_s).astype(np.int64),
+            "s_profit": rng.integers(-2_000, 5_000, n_s).astype(np.int64),
+            "r_sk": rng.integers(0, 1000, n_r).astype(np.int64),
+            "r_date": rng.integers(0, n_dates, n_r).astype(np.int64),
+            "r_amt": rng.integers(1, 8_000, n_r).astype(np.int64),
+            "r_loss": rng.integers(1, 3_000, n_r).astype(np.int64),
+        }
+    date_sk = np.arange(n_dates, dtype=np.int64)
+    return chans, date_sk
+
+
+DATE_LO, DATE_HI = 700, 714          # the 14-day window of the real q5
+
+
+def _col(arr):
+    import jax.numpy as jnp
+    from spark_rapids_tpu import Column, dtypes
+    return Column(dtype=dtypes.INT64, length=len(arr), data=jnp.asarray(arr))
+
+
+def build_tables(n_sales: int, seed=0):
+    from spark_rapids_tpu import Table
+    chans, date_sk = _datagen(n_sales, seed)
+    tabs = {}
+    for name, c in chans.items():
+        tabs[name] = (
+            Table([_col(c["s_sk"]), _col(c["s_date"]), _col(c["s_price"]),
+                   _col(c["s_profit"])],
+                  names=["sk", "date_sk", "sales_price", "profit"]),
+            Table([_col(c["r_sk"]), _col(c["r_date"]), _col(c["r_amt"]),
+                   _col(c["r_loss"])],
+                  names=["sk", "date_sk", "return_amt", "net_loss"]))
+    dates = Table([_col(date_sk)], names=["d_date_sk"])
+    return tabs, dates
+
+
+def q5(tabs, dates):
+    """The Q5-shaped plan, shared by bench and tests/test_nds_query.py."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu import Column, Table, dtypes
+    from spark_rapids_tpu.ops import (apply_boolean_mask, concat_tables,
+                                      groupby_aggregate, inner_join,
+                                      sort_table, take_table)
+
+    dwin = apply_boolean_mask(
+        dates, (dates["d_date_sk"].data >= DATE_LO) &
+               (dates["d_date_sk"].data < DATE_HI))
+
+    def const(n, v):
+        return Column(dtype=dtypes.INT64, length=n,
+                      data=jnp.full((n,), v, jnp.int64))
+
+    per_channel = []
+    for ci, (name, (sales, returns)) in enumerate(tabs.items()):
+        ns, nr = sales.num_rows, returns.num_rows
+        # UNION ALL: sales rows carry (price, profit, 0, 0); returns carry
+        # (0, 0, amt, loss) — the q5 ssr/csr/wsr pattern
+        s_rows = Table([sales["sk"], sales["date_sk"], sales["sales_price"],
+                        sales["profit"], const(ns, 0), const(ns, 0)],
+                       names=["sk", "date_sk", "sales", "profit",
+                              "returns", "loss"])
+        r_rows = Table([returns["sk"], returns["date_sk"], const(nr, 0),
+                        const(nr, 0), returns["return_amt"],
+                        returns["net_loss"]],
+                       names=s_rows.names)
+        u = concat_tables([s_rows, r_rows])
+        lm, _ = inner_join([u["date_sk"]], [dwin["d_date_sk"]])
+        uf = take_table(u, lm.data)
+        agg = groupby_aggregate(uf, ["sk"],
+                                [("sales", "sum"), ("returns", "sum"),
+                                 ("profit", "sum"), ("loss", "sum")])
+        g = Table(list(agg), names=["sk", "sales", "returns", "profit",
+                                    "loss"])
+        g = Table([const(g.num_rows, ci)] + list(g.columns),
+                  names=["channel"] + list(g.names))
+        per_channel.append(g)
+
+    allch = concat_tables(per_channel)
+    # rollup level 1: channel subtotals
+    by_chan = groupby_aggregate(allch, ["channel"],
+                                [("sales", "sum"), ("returns", "sum"),
+                                 ("profit", "sum"), ("loss", "sum")])
+    sub = Table(list(by_chan), names=["channel", "sales", "returns",
+                                      "profit", "loss"])
+    # rollup level 2: grand total (groupby on a constant key)
+    allc = Table([const(allch.num_rows, -1)] + list(allch.columns)[1:],
+                 names=sub.names)
+    total = groupby_aggregate(allc, ["channel"],
+                              [("sales", "sum"), ("returns", "sum"),
+                               ("profit", "sum"), ("loss", "sum")])
+    rollup = concat_tables([sub, Table(list(total), names=sub.names)])
+    return sort_table(rollup, key_names=["channel", "sales"],
+                      ascending=[True, False])
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    n_sales = max(int(10_000_000 * args.scale), 8192)
+    tabs, dates = build_tables(n_sales)
+    n_total = sum(t.num_rows + r.num_rows for t, r in tabs.values())
+
+    run_config("nds_q5_pipeline", {"num_rows": n_total},
+               lambda *a: [c.data for c in q5(
+                   {k: (a[2 * i], a[2 * i + 1])
+                    for i, k in enumerate(tabs)}, a[-1]).columns],
+               tuple(x for pair in tabs.values() for x in pair) + (dates,),
+               n_rows=n_total, iters=args.iters,
+               jit=False)   # join output sizes are data-dependent
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""NDS/TPC-DS Q72-shaped end-to-end pipeline (BASELINE.json configs[4]).
+Q72 is the *deep multi-join*: catalog_sales chained through inventory,
+warehouse, item, household_demographics and three date_dim roles, with a
+non-equi residual (inv_quantity_on_hand < cs_quantity) and a date-offset
+residual (ship date more than 5 days after sold date), then
+groupby + order + limit.
+
+Shape exercised (all public ops):
+    catalog_sales ⋈ household_demographics(buy_potential)
+                  ⋈ item ⋈ date_dim d1 (year)
+                  ⋈ inventory (on item)  ⋈ warehouse
+      [residual: inv_qty < cs_qty]  [residual: d_ship > d_sold + 5]
+    → groupby (item, warehouse, week) count → order by count desc, keys
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from benchmarks.common import parse_args, run_config  # noqa: E402
+
+
+def _datagen(n_sales: int, seed=0):
+    rng = np.random.default_rng(seed)
+    n_items, n_wh, n_hd, n_dates = 500, 15, 20, 365 * 2
+    cs = {"item_sk": rng.integers(0, n_items, n_sales).astype(np.int64),
+          "hd_sk": rng.integers(0, n_hd, n_sales).astype(np.int64),
+          "sold_date_sk": rng.integers(0, n_dates - 10, n_sales).astype(np.int64),
+          "ship_days": rng.integers(0, 14, n_sales).astype(np.int64),
+          "qty": rng.integers(1, 20, n_sales).astype(np.int64)}
+    # inventory: one row per (item, week) with a quantity on hand
+    n_weeks = n_dates // 7
+    item_g, week_g = np.meshgrid(np.arange(n_items), np.arange(n_weeks))
+    inv = {"inv_item_sk": item_g.ravel().astype(np.int64),
+           "inv_week": week_g.ravel().astype(np.int64),
+           "inv_wh_sk": rng.integers(0, n_wh, item_g.size).astype(np.int64),
+           "inv_qty": rng.integers(0, 25, item_g.size).astype(np.int64)}
+    items = {"i_item_sk": np.arange(n_items, dtype=np.int64),
+             "i_brand": rng.integers(0, 50, n_items).astype(np.int64)}
+    hd = {"hd_demo_sk": np.arange(n_hd, dtype=np.int64),
+          "hd_buy_potential": rng.integers(0, 5, n_hd).astype(np.int64)}
+    wh = {"w_warehouse_sk": np.arange(n_wh, dtype=np.int64)}
+    dates = {"d_date_sk": np.arange(n_dates, dtype=np.int64),
+             "d_week": (np.arange(n_dates) // 7).astype(np.int64),
+             "d_year": (np.arange(n_dates) // 365).astype(np.int64)}
+    return cs, inv, items, hd, wh, dates
+
+
+def _col(arr):
+    import jax.numpy as jnp
+    from spark_rapids_tpu import Column, dtypes
+    return Column(dtype=dtypes.INT64, length=len(arr), data=jnp.asarray(arr))
+
+
+def _tab(d):
+    from spark_rapids_tpu import Table
+    return Table([_col(v) for v in d.values()], names=list(d.keys()))
+
+
+def build_tables(n_sales: int, seed=0):
+    return tuple(_tab(d) for d in _datagen(n_sales, seed))
+
+
+def q72(cs, inv, items, hd, wh, dates):
+    """The Q72-shaped plan, shared by bench and tests/test_nds_query.py."""
+    from spark_rapids_tpu import Table
+    from spark_rapids_tpu.ops import (apply_boolean_mask, groupby_aggregate,
+                                      inner_join, sort_table, take_table)
+
+    def join(left, lkey, right, rkey):
+        lm, rm = inner_join([left[lkey]], [right[rkey]])
+        return Table(list(take_table(left, lm.data).columns) +
+                     list(take_table(right, rm.data).columns),
+                     names=list(left.names) + list(right.names))
+
+    # dim filters first
+    hd_f = apply_boolean_mask(hd, hd["hd_buy_potential"].data == 3)
+    d1 = apply_boolean_mask(dates, dates["d_year"].data == 1)
+
+    j = join(cs, "hd_sk", hd_f, "hd_demo_sk")              # demographics
+    j = join(j, "item_sk", items, "i_item_sk")             # item
+    j = join(j, "sold_date_sk", d1, "d_date_sk")           # d1: sold year
+    # residual: ship more than 5 days after sold
+    j = apply_boolean_mask(j, j["ship_days"].data > 5)
+    j = join(j, "i_item_sk", inv, "inv_item_sk")           # inventory (big)
+    # residuals: same week on hand, short stock
+    j = apply_boolean_mask(j, (j["inv_week"].data == j["d_week"].data) &
+                              (j["inv_qty"].data < j["qty"].data))
+    j = join(j, "inv_wh_sk", wh, "w_warehouse_sk")         # warehouse
+
+    agg = groupby_aggregate(j, ["i_item_sk", "w_warehouse_sk", "d_week"],
+                            [("qty", "size")])
+    out = Table(list(agg), names=["i_item_sk", "w_warehouse_sk", "d_week",
+                                  "cnt"])
+    return sort_table(out,
+                      key_names=["cnt", "i_item_sk", "w_warehouse_sk",
+                                 "d_week"],
+                      ascending=[False, True, True, True])
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    n_sales = max(int(10_000_000 * args.scale), 8192)
+    tabs = build_tables(n_sales)
+
+    run_config("nds_q72_pipeline", {"num_sales": tabs[0].num_rows},
+               lambda *a: [c.data for c in q72(*a).columns],
+               tabs, n_rows=tabs[0].num_rows, iters=args.iters,
+               jit=False)   # join output sizes are data-dependent
+
+
+if __name__ == "__main__":
+    main()

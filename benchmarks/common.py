@@ -55,8 +55,12 @@ def sync(out) -> None:
     chain ran."""
     leaves = [l for l in jax.tree_util.tree_leaves(out)
               if isinstance(l, jax.Array) and l.size]
-    if leaves:
-        np.asarray(jnp.ravel(leaves[-1])[:1])
+    if not leaves:
+        # no readback anchor → the timing loop would measure dispatch only
+        print("WARNING: sync(): output has no non-empty jax.Array leaf; "
+              "timing will not include device execution", file=sys.stderr)
+        return
+    np.asarray(jnp.ravel(leaves[-1])[:1])
 
 
 def steady_state_ms(fn: Callable, args, iters: int, platform: str) -> float:
@@ -90,12 +94,19 @@ def steady_state_ms(fn: Callable, args, iters: int, platform: str) -> float:
         sync(r)
         return (time.perf_counter() - t0) * 1e3
 
-    t1 = loop(iters)
-    t2 = loop(2 * iters)
-    ms = (t2 - t1) / iters
-    if not ms > 0:                      # noise floor: bounded mean fallback
-        ms = t2 / (2 * iters)
-    return ms
+    steady_state_ms.last_upper_bound = False
+    for _ in range(3):                  # escalate iters while below the
+        t1 = loop(iters)                # differencing noise floor
+        t2 = loop(2 * iters)
+        ms = (t2 - t1) / iters
+        if ms > 0:
+            return ms
+        last_iters = iters
+        iters *= 4
+    # still non-positive: bounded mean folds the ~65 ms tunnel sync into the
+    # per-iter time → an upper bound, flagged so records can say so
+    steady_state_ms.last_upper_bound = True
+    return t2 / (2 * last_iters)
 
 
 def run_config(bench: str, axes: Dict, fn: Callable, args, *, n_rows: int,
@@ -113,6 +124,9 @@ def run_config(bench: str, axes: Dict, fn: Callable, args, *, n_rows: int,
     ms = steady_state_ms(fn, args, iters, jax.default_backend())
     rec = {"bench": bench, "axes": axes, "ms": round(ms, 3),
            "rows_per_s": round(n_rows / (ms * 1e-3))}
+    if getattr(steady_state_ms, "last_upper_bound", False):
+        rec["ms_upper_bound"] = True    # sync round-trip folded in; see
+        # steady_state_ms noise-floor fallback
     print(json.dumps(rec), flush=True)
     return rec
 

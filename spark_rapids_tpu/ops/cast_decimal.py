@@ -27,6 +27,13 @@ Semantics preserved:
 Known deviation: exponent values are accumulated in int64 even for
 DECIMAL128 (the reference uses int128), so exponents with |e| > 2^63 parse
 invalid instead of producing a zero/overflow — unreachable for sane data.
+Exponents that pass that bound are then clamped to ±2^40 before the
+decimal-location arithmetic: every downstream comparison is against
+quantities ≤ 39 + precision + row length, so any |e| beyond the clamp
+behaves identically (huge positive → overflow/null via the zero-padding
+check, huge negative → all digits insignificant → 0) while `dl + e` can
+no longer wrap int64 (an exponent like 9e9223372036854775807 previously
+wrapped to a *valid 0* instead of null).
 """
 from __future__ import annotations
 
@@ -147,6 +154,9 @@ def string_to_decimal(col: Column, precision: int, scale: int,
     valid &= ~eof
     exp_val = jax.lax.bitcast_convert_type(
         jnp.where(exp_positive, emag, jnp.uint64(0) - emag), jnp.int64)
+    # clamp far past any digit-count scale so dl + exp_val cannot wrap int64
+    # (see module docstring: downstream only compares against ≤ 39 + p + L)
+    exp_val = jnp.clip(exp_val, -(2**40), 2**40)
 
     # ---- decimal location -----------------------------------------------------
     # chars-from-istart index of the '.', or the mantissa digit count

@@ -115,3 +115,15 @@ class TestStringToDecimal:
         r = run(["12 ", "1.5 ", " 8.2  ", "1. ", " 12"], 7, -1)
         np.testing.assert_array_equal(np.asarray(r.null_mask), [1] * 5)
         assert r.to_pylist() == [120, 15, 82, 10, 120]
+
+    def test_huge_exponent_no_int64_wrap(self):
+        # exponents just under 2^63 used to wrap dl + e to a *valid 0*;
+        # they must overflow (null), like any exponent past the padding
+        # bound. Huge negative exponents stay valid 0 (value rounds to 0).
+        r = run(["9e9223372036854775807", "1e9223372036854775806",
+                 "9e-9223372036854775807", "0e9223372036854775807",
+                 "1e40", "1e-40"], 38, 0)
+        np.testing.assert_array_equal(np.asarray(r.null_mask),
+                                      [0, 0, 1, 0, 0, 1])
+        got = r.to_pylist()
+        assert got[2] == 0 and got[5] == 0

@@ -44,3 +44,96 @@ def test_nds_q3_pipeline_matches_pandas():
     np.testing.assert_array_equal(got.revenue.values, ref.revenue.values)
     assert (sorted(zip(got.d_year, got.i_brand, got.revenue)) ==
             sorted(zip(ref.d_year, ref.i_brand, ref.revenue)))
+
+
+def test_nds_q5_pipeline_matches_pandas():
+    from benchmarks.bench_nds_q5 import (DATE_HI, DATE_LO, _datagen,
+                                         build_tables, q5)
+    n_sales = 30_000
+    tabs, dates = build_tables(n_sales, seed=3)
+    out = q5(tabs, dates)
+
+    chans, _ = _datagen(n_sales, seed=3)
+    frames = []
+    for ci, (name, c) in enumerate(chans.items()):
+        s = pd.DataFrame({"sk": c["s_sk"], "date_sk": c["s_date"],
+                          "sales": c["s_price"], "profit": c["s_profit"],
+                          "returns": 0, "loss": 0})
+        r = pd.DataFrame({"sk": c["r_sk"], "date_sk": c["r_date"],
+                          "sales": 0, "profit": 0, "returns": c["r_amt"],
+                          "loss": c["r_loss"]})
+        u = pd.concat([s, r])
+        u = u[(u.date_sk >= DATE_LO) & (u.date_sk < DATE_HI)]
+        g = (u.groupby("sk", as_index=False)
+              .agg(sales=("sales", "sum"), returns=("returns", "sum"),
+                   profit=("profit", "sum"), loss=("loss", "sum")))
+        g.insert(0, "channel", ci)
+        frames.append(g)
+    allch = pd.concat(frames)
+    sub = (allch.groupby("channel", as_index=False)
+                .agg(sales=("sales", "sum"), returns=("returns", "sum"),
+                     profit=("profit", "sum"), loss=("loss", "sum")))
+    tot = sub.drop(columns="channel").sum()
+    ref = pd.concat([sub, pd.DataFrame([{"channel": -1, **tot}])])
+    ref = ref.sort_values(["channel", "sales"], ascending=[True, False])
+
+    got = pd.DataFrame({n: out[n].to_pylist() for n in out.names})
+    assert len(got) == len(ref) == 4
+    for c in ("channel", "sales", "returns", "profit", "loss"):
+        np.testing.assert_array_equal(got[c].values, ref[c].values, err_msg=c)
+
+
+def test_nds_q23_pipeline_matches_pandas():
+    from benchmarks.bench_nds_q23 import (BEST_FRACTION, FREQ_THRESHOLD,
+                                          _datagen, build_tables, q23)
+    n_sales = 30_000
+    store, sides = build_tables(n_sales, seed=11)
+    got = int(q23(store, sides))
+
+    s, sd = _datagen(n_sales, seed=11)
+    sdf = pd.DataFrame(s)
+    freq = sdf.groupby("item_sk").size()
+    freq_items = set(freq[freq > FREQ_THRESHOLD].index)
+    sdf["rev"] = sdf.qty * sdf.price
+    by_cust = sdf.groupby("cust_sk").rev.sum()
+    best = set(by_cust[by_cust > BEST_FRACTION * by_cust.max()].index)
+    total = 0
+    for side in sd.values():
+        df = pd.DataFrame(side)
+        df = df[df.item_sk.isin(freq_items) & df.cust_sk.isin(best)]
+        total += int((df.qty * df.price).sum())
+    assert got == total
+    assert total > 0                      # the HAVING clauses selected rows
+
+
+def test_nds_q72_pipeline_matches_pandas():
+    from benchmarks.bench_nds_q72 import _datagen, build_tables, q72
+    n_sales = 30_000
+    out = q72(*build_tables(n_sales, seed=5))
+
+    cs, inv, items, hd, wh, dates = _datagen(n_sales, seed=5)
+    csdf = pd.DataFrame(cs)
+    hddf = pd.DataFrame(hd)
+    j = csdf.merge(hddf[hddf.hd_buy_potential == 3], left_on="hd_sk",
+                   right_on="hd_demo_sk")
+    j = j.merge(pd.DataFrame(items), left_on="item_sk", right_on="i_item_sk")
+    ddf = pd.DataFrame(dates)
+    j = j.merge(ddf[ddf.d_year == 1], left_on="sold_date_sk",
+                right_on="d_date_sk")
+    j = j[j.ship_days > 5]
+    j = j.merge(pd.DataFrame(inv), left_on="i_item_sk",
+                right_on="inv_item_sk")
+    j = j[(j.inv_week == j.d_week) & (j.inv_qty < j.qty)]
+    j = j.merge(pd.DataFrame(wh), left_on="inv_wh_sk",
+                right_on="w_warehouse_sk")
+    ref = (j.groupby(["i_item_sk", "w_warehouse_sk", "d_week"],
+                     as_index=False).size()
+            .rename(columns={"size": "cnt"})
+            .sort_values(["cnt", "i_item_sk", "w_warehouse_sk", "d_week"],
+                         ascending=[False, True, True, True]))
+
+    got = pd.DataFrame({n: out[n].to_pylist() for n in out.names})
+    assert len(got) == len(ref)
+    assert len(got) > 0
+    for c in ("i_item_sk", "w_warehouse_sk", "d_week", "cnt"):
+        np.testing.assert_array_equal(got[c].values, ref[c].values, err_msg=c)
