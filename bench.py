@@ -30,6 +30,27 @@ UNIT = "Mrows/s (murmur3_32+xxhash64, 2xint64, 10M rows)"
 DEVICE_ATTEMPTS = 2
 DEVICE_TIMEOUT_S = 300
 RETRY_SLEEP_S = 15
+TUNNEL_PORTS = (8090, 8091, 8092, 8093, 8094)
+
+
+def probe_tunnel(timeout_s: float = 3.0):
+    """Healthz probe for the axon TPU tunnel (same probe as ci/tpu-smoke.sh).
+
+    Returns a human-readable status string; 'dead' means no port answered.
+    A dead tunnel makes every TPU op HANG (round-3 BENCH burned a 300 s
+    timeout on it), so the orchestrator checks this first and goes straight
+    to the CPU fallback in <5 s, recording the probe result so the driver
+    can distinguish 'tunnel down' from 'kernel regressed'.
+    """
+    import urllib.request
+    for port in TUNNEL_PORTS:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=timeout_s)
+            return f"ok:{port}"
+        except Exception:
+            continue
+    return "dead"
 
 
 def _bench(fn, args, iters, platform):
@@ -119,6 +140,15 @@ def _parse_result_line(stdout: str):
 def orchestrate() -> None:
     """Try the device backend in a killable child; fall back to CPU."""
     errors = []
+    health = probe_tunnel()
+    if health == "dead" and os.environ.get("SRT_BENCH_FORCE_DEVICE", "") != "1":
+        errors.append("tunnel healthz dead on ports "
+                      f"{'-'.join(str(p) for p in (TUNNEL_PORTS[0], TUNNEL_PORTS[-1]))}"
+                      " — skipping device attempts (set SRT_BENCH_FORCE_DEVICE=1"
+                      " to override)")
+        print(f"bench: {errors[-1]}", file=sys.stderr)
+        _cpu_fallback(errors, health)
+        return
     for attempt in range(1, DEVICE_ATTEMPTS + 1):
         try:
             p = subprocess.run(
@@ -126,6 +156,7 @@ def orchestrate() -> None:
                 capture_output=True, text=True, timeout=DEVICE_TIMEOUT_S)
             rec = _parse_result_line(p.stdout)
             if p.returncode == 0 and rec is not None and rec.get("value") is not None:
+                rec["tunnel_healthz"] = health
                 print(json.dumps(rec))
                 return
             errors.append(f"attempt {attempt}: rc={p.returncode} "
@@ -138,8 +169,11 @@ def orchestrate() -> None:
         print(f"bench: {errors[-1]}", file=sys.stderr)
         if attempt < DEVICE_ATTEMPTS:
             time.sleep(RETRY_SLEEP_S)
+    _cpu_fallback(errors, health)
 
-    # CPU fallback, still in a killable child
+
+def _cpu_fallback(errors, health) -> None:
+    """CPU-fallback measurement, still in a killable child."""
     try:
         p = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--measure", "--cpu"],
@@ -148,6 +182,7 @@ def orchestrate() -> None:
         if rec is not None and rec.get("value") is not None:
             rec["error"] = ("device backend unavailable, measured on CPU: "
                             + " | ".join(errors))
+            rec["tunnel_healthz"] = health
             print(json.dumps(rec))
             return
         errors.append(f"cpu fallback: rc={p.returncode} "
@@ -161,6 +196,7 @@ def orchestrate() -> None:
         "unit": UNIT,
         "vs_baseline": None,
         "error": " | ".join(errors),
+        "tunnel_healthz": health,
     }))
 
 

@@ -115,8 +115,10 @@ def q5(tabs, dates):
                                  ("profit", "sum"), ("loss", "sum")])
     sub = Table(list(by_chan), names=["channel", "sales", "returns",
                                       "profit", "loss"])
-    # rollup level 2: grand total (groupby on a constant key)
-    allc = Table([const(allch.num_rows, -1)] + list(allch.columns)[1:],
+    # rollup level 2: grand total (groupby on a constant key). Drop both
+    # `channel` and `sk` — only the 4 measure columns are aggregated, so the
+    # 5 columns here must line up 1:1 with sub.names.
+    allc = Table([const(allch.num_rows, -1)] + list(allch.columns)[2:],
                  names=sub.names)
     total = groupby_aggregate(allc, ["channel"],
                               [("sales", "sum"), ("returns", "sum"),

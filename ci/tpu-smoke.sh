@@ -23,5 +23,8 @@ SRT_TPU_SMOKE=1 timeout "${SRT_TPU_SMOKE_TIMEOUT:-3600}" \
 rc=$?
 if [ $rc -eq 124 ]; then
   echo "tpu-smoke: timed out (tunnel hang mid-run?)" >&2
+elif [ $rc -eq 5 ]; then
+  echo "tpu-smoke: pytest collected 0 tests — marker/rootdir configuration error, not a pass" >&2
+  exit 70   # EX_SOFTWARE: the tier itself is broken
 fi
 exit $rc

@@ -26,6 +26,9 @@ class Table:
         columns = tuple(columns)
         if names is None:
             names = tuple(f"c{i}" for i in range(len(columns)))
+        assert len(names) == len(columns), (
+            f"{len(names)} names for {len(columns)} columns — a mismatched "
+            "binding silently shifts every name-based lookup")
         if len(columns) > 1:
             n0 = columns[0].length
             for c in columns[1:]:

@@ -50,61 +50,72 @@ def _agg_value_dtype(op: str, dt: dtypes.DType) -> dtypes.DType:
     return dt  # min/max keep the input type
 
 
-@partial(jax.jit, static_argnames=("n_ops", "agg_kinds"))
+@partial(jax.jit, static_argnames=("n_ops", "agg_kinds", "has_valids"))
 def _groupby_kernel(key_operands, agg_datas, agg_valids, *, n_ops: int,
-                    agg_kinds: Tuple[str, ...]):
-    """Scatter-free sorted aggregation.
+                    agg_kinds: Tuple[str, ...], has_valids: Tuple[bool, ...]):
+    """Scatter-free, gather-free sorted aggregation (round-4 redesign).
 
-    TPU scatter (what segment_sum lowers to) is slow — ~1s for 10M int64
-    adds under 64-bit emulation — while sort, cumsum and gather are fast. On
-    key-sorted data every reduction is expressible without scatter:
+    On-chip primitive costs (tools/primitives sweep + docs/architecture.md,
+    10M rows): sort ≈ 38 ms with cheap marginal payload operands, cumsum ≈
+    16 ms, but a RANDOM GATHER ≈ 160 ms and a random scatter ≈ 930 ms. The
+    previous kernel did one value gather per aggregation plus 4 positional
+    gathers per cumsum-difference — gathers dominated (~0.9 s at 10M). This
+    version has zero data-sized gathers:
 
-      sum(group j)  = cumsum[end_j - 1] - cumsum[start_j - 1]
-      min/max       = segmented running-min via ONE associative_scan that
-                      resets at group boundaries, read at end_j - 1
-      starts/ends   = boundary-compaction sort (one extra 2-operand int32
-                      sort; padded to n so shapes stay static)
+      * value/validity columns ride the MAIN key sort as payload operands
+        (stable sort ⇒ payload order == the old gather-by-order);
+      * int sums/counts: one exclusive cumsum each; the per-group value is
+        the difference of the cumsum between CONSECUTIVE group starts, read
+        off adjacent entries after compaction — no positional gathers. The
+        compaction pad value is the cumsum total, which makes the adjacent
+        difference correct for the last group for free;
+      * float sums and min/max: one REVERSE segmented associative_scan each
+        (result lands on the group's first row — the row compaction keeps);
+      * ONE boundary-compaction sort packs every group-start row (position,
+        original row id, and all per-agg results) to the front — replacing
+        both the old starts sort and every per-agg gather. searchsorted
+        stays banned (it lowers to ~log2(n) whole-array gather passes).
 
-    This is ~12x faster than segment_sum-based aggregation at 10M rows.
+    Returns (num_groups, starts, first_rows, outs): all n-length, entries
+    past num_groups are padding (positions hold n), sliced/masked by the
+    caller.
     """
     n = key_operands[0].shape[0]
     iota = jnp.arange(n, dtype=jnp.int32)
-    sorted_all = jax.lax.sort([*key_operands, iota], num_keys=n_ops,
-                              is_stable=True)
-    sorted_ops, order = sorted_all[:-1], sorted_all[-1]
+
+    # ---- payload layout for the main sort --------------------------------
+    payloads: List = []
+    slots: List[Tuple[Optional[int], Optional[int]]] = []  # (data, valid)
+    for data, valid, op, hv in zip(agg_datas, agg_valids, agg_kinds,
+                                   has_valids):
+        d_slot = v_slot = None
+        if op not in ("size", "count"):
+            d_slot = len(payloads)
+            payloads.append(data)
+        if hv:
+            v_slot = len(payloads)
+            payloads.append(valid.astype(jnp.int8))
+        slots.append((d_slot, v_slot))
+
+    sorted_all = jax.lax.sort([*key_operands, iota, *payloads],
+                              num_keys=n_ops, is_stable=True)
+    sorted_ops = sorted_all[:n_ops]
+    order = sorted_all[n_ops]
+    spay = sorted_all[n_ops + 1:]
 
     neq = jnp.zeros((n,), bool)
     for o in sorted_ops:
         neq = neq | (o != jnp.roll(o, 1))
     boundary = neq.at[0].set(True) if n else neq   # guard: empty scatter OOB
-    gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-    num_groups = (gid[-1] + 1) if n else jnp.int32(0)
-    # group start/end positions in the sorted frame, padded to n entries
-    # (entries past num_groups are n and sliced off by the caller).
-    # Boundary-compaction sort, NOT searchsorted: jnp.searchsorted lowers to
-    # ~log2(n) whole-array gather passes on TPU (~2s at 10M), while one more
-    # 2-operand int32 sort is ~40ms.
-    flag = jnp.where(boundary, jnp.int32(0), jnp.int32(1))
-    payload = jnp.where(boundary, iota, jnp.int32(n))
-    starts = jax.lax.sort([flag, payload], num_keys=1, is_stable=True)[1]
-    if n:
-        ends = jnp.concatenate([starts[1:], jnp.full((1,), n, jnp.int32)])
-    else:
-        ends = starts
-    last = jnp.clip(ends - 1, 0, max(n - 1, 0))
-    prev = starts - 1  # -1 for group 0 → masked below
+    ends_flag = jnp.roll(boundary, -1).at[-1].set(True) if n else boundary
+    num_groups = jnp.sum(boundary.astype(jnp.int32))
 
-    def ends_minus_starts(csum):
-        at_end = jnp.take(csum, last, axis=0)
-        at_prev = jnp.where(prev >= 0, jnp.take(csum, jnp.maximum(prev, 0),
-                                                axis=0), 0)
-        return at_end - at_prev
-
-    def segmented_scan(vals, kind: str):
-        """Running sum/min/max that resets at boundaries; segment result
-        sits at the segment's last row. Floats use this for sums too — a
-        global-cumsum difference would let one NaN/Inf poison every group
-        sorted after it."""
+    def rev_segscan(vals, kind: str):
+        """Reverse segmented sum/min/max: resets walking backwards at group
+        ENDS, so each group's reduction lands on its FIRST row (which the
+        compaction keeps). Floats use this for sums too — a global-cumsum
+        difference would let one NaN/Inf poison every group sorted after
+        it."""
         def combine(a, b):
             abound, aval = a
             bbound, bval = b
@@ -115,36 +126,53 @@ def _groupby_kernel(key_operands, agg_datas, agg_valids, *, n_ops: int,
             else:
                 merged0 = jnp.maximum(aval, bval)
             return abound | bbound, jnp.where(bbound, bval, merged0)
-        _, res = jax.lax.associative_scan(combine, (boundary, vals))
-        return jnp.take(res, last, axis=0)
+        _, res = jax.lax.associative_scan(combine, (ends_flag, vals),
+                                          reverse=True)
+        return res
 
-    outs = []
-    for (data, valid), op in zip(zip(agg_datas, agg_valids), agg_kinds):
-        if op == "size":
-            outs.append((ends.astype(jnp.int64) - starts.astype(jnp.int64),
-                         None))
+    # compaction operands: group-start rows to the front, everything they
+    # need riding along as payloads
+    pad_i32 = jnp.int32(n)
+    comp_pay: List = [jnp.where(boundary, iota, pad_i32),       # position
+                      jnp.where(boundary, order, pad_i32)]      # first row
+    # per-agg: (payload index in comp_pay, mode, pad-side info)
+    agg_comp: List = []
+    totals = {}          # comp_pay slot -> cumsum grand total (traced scalar)
+    for (d_slot, v_slot), op in zip(slots, agg_kinds):
+        ok = (spay[v_slot] == 1) if v_slot is not None else None
+        cnt_slot = None
+        if op != "size":
+            okv = ok if ok is not None else jnp.ones((n,), bool)
+            csum = jnp.cumsum(okv.astype(jnp.int64))
+            excl = csum - okv.astype(jnp.int64)
+            total = csum[-1] if n else jnp.int64(0)
+            cnt_slot = len(comp_pay)
+            totals[cnt_slot] = total
+            comp_pay.append(jnp.where(boundary, excl, total))
+        if op in ("size", "count"):
+            agg_comp.append((None, op, cnt_slot))
             continue
-        ok = (jnp.take(valid, order, axis=0) if valid is not None
-              else jnp.ones((n,), bool))
-        cnt = ends_minus_starts(jnp.cumsum(ok.astype(jnp.int64)))
-        if op == "count":
-            outs.append((cnt, None))
-            continue
-        v = jnp.take(data, order, axis=0)
+        v = spay[d_slot]
+        okv = ok if ok is not None else jnp.ones((n,), bool)
         if op in ("sum", "mean"):
             if v.dtype.kind == "f" or op == "mean":
-                # segmented scan, NOT cumsum-difference: NaN/Inf must stay
-                # confined to their own group
-                acc = jnp.where(ok, v.astype(jnp.float64), 0.0)
-                s = segmented_scan(acc, "sum")
+                acc = jnp.where(okv, v.astype(jnp.float64), 0.0)
+                res = rev_segscan(acc, "sum")
+                slot = len(comp_pay)
+                comp_pay.append(jnp.where(boundary, res, 0.0))
+                agg_comp.append((slot, "fsum" if op == "sum" else "mean",
+                                 cnt_slot))
             else:
-                # int64 cumsum-difference is exact under two's-complement
-                # wraparound (Java long semantics) and immune to poisoning
-                acc = jnp.where(ok, v.astype(jnp.int64), jnp.int64(0))
-                s = ends_minus_starts(jnp.cumsum(acc))
-            if op == "mean":
-                s = s / jnp.where(cnt == 0, 1, cnt).astype(jnp.float64)
-            outs.append((s, cnt > 0))
+                acc = jnp.where(okv, v.astype(jnp.int64), jnp.int64(0))
+                csum = jnp.cumsum(acc)
+                excl = csum - acc
+                total = csum[-1] if n else jnp.int64(0)
+                slot = len(comp_pay)
+                totals[slot] = total
+                # pad value = total ⇒ the adjacent difference of the last
+                # real group reads (total - its exclusive prefix) — exact
+                comp_pay.append(jnp.where(boundary, excl, total))
+                agg_comp.append((slot, "isum", cnt_slot))
             continue
         # min / max with null-ignoring identities. Floats go through the
         # total-order transform so NaN behaves like Spark: NaN is greatest,
@@ -154,20 +182,71 @@ def _groupby_kernel(key_operands, agg_datas, agg_valids, *, n_ops: int,
             from .sort import _float_total_order
             tv = _float_total_order(v)
             info = jnp.iinfo(tv.dtype)
-            ident = jnp.asarray(info.max if op == "min" else info.min, tv.dtype)
-            masked = jnp.where(ok, tv, ident)
-            ext = segmented_scan(masked, "min" if op == "min" else "max")
-            sign_bit = jnp.asarray(info.min, tv.dtype)
-            bits = jnp.where(ext < 0, ~(ext ^ sign_bit), ext)
-            outs.append((jax.lax.bitcast_convert_type(bits, v.dtype), cnt > 0))
+            ident = jnp.asarray(info.max if op == "min" else info.min,
+                                tv.dtype)
+            masked = jnp.where(okv, tv, ident)
+            ext = rev_segscan(masked, "min" if op == "min" else "max")
+            slot = len(comp_pay)
+            comp_pay.append(jnp.where(boundary, ext, ident))
+            agg_comp.append((slot, "fext:" + str(v.dtype), cnt_slot))
         else:
             info = jnp.iinfo(v.dtype)
-            ident = jnp.asarray(info.max if op == "min" else info.min, v.dtype)
-            masked = jnp.where(ok, v, ident)
-            outs.append((segmented_scan(masked, "min" if op == "min" else "max"),
-                         cnt > 0))
+            ident = jnp.asarray(info.max if op == "min" else info.min,
+                                v.dtype)
+            masked = jnp.where(okv, v, ident)
+            ext = rev_segscan(masked, "min" if op == "min" else "max")
+            slot = len(comp_pay)
+            comp_pay.append(jnp.where(boundary, ext, ident))
+            agg_comp.append((slot, "ext", cnt_slot))
 
-    return num_groups, starts, order, outs
+    flag = jnp.where(boundary, jnp.int32(0), jnp.int32(1))
+    comp = jax.lax.sort([flag, *comp_pay], num_keys=1, is_stable=True)[1:]
+    starts, first_rows = comp[0], comp[1]
+
+    def adj_diff(arr, tail):
+        if n == 0:
+            return arr
+        return jnp.concatenate([arr[1:], jnp.full((1,), tail, arr.dtype)]) - arr
+
+    # sizes from the compacted start positions (pad n makes the last group's
+    # difference read n - start — exact)
+    sizes = adj_diff(starts.astype(jnp.int64), n)
+
+    def adj_diff_total(arr, total):
+        """Adjacent difference whose final element reads against the scalar
+        `total`; pad entries equal `total` so padded diffs are 0."""
+        if n == 0:
+            return arr
+        return jnp.concatenate([arr[1:], total[None]]) - arr
+
+    outs = []
+    for (slot, mode, cnt_slot), op in zip(agg_comp, agg_kinds):
+        cnt = None
+        if cnt_slot is not None:
+            cnt = adj_diff_total(comp[cnt_slot], totals[cnt_slot])
+        if op == "size":
+            outs.append((sizes, None))
+        elif op == "count":
+            outs.append((cnt, None))
+        elif mode == "isum":
+            s = adj_diff_total(comp[slot], totals[slot])
+            outs.append((s, cnt > 0))
+        elif mode == "fsum":
+            outs.append((comp[slot], cnt > 0))
+        elif mode == "mean":
+            s = comp[slot] / jnp.where(cnt == 0, 1, cnt).astype(jnp.float64)
+            outs.append((s, cnt > 0))
+        elif mode.startswith("fext:"):
+            ext = comp[slot]
+            info = jnp.iinfo(ext.dtype)
+            sign_bit = jnp.asarray(info.min, ext.dtype)
+            bits = jnp.where(ext < 0, ~(ext ^ sign_bit), ext)
+            fdt = jnp.dtype(mode.split(":", 1)[1])
+            outs.append((jax.lax.bitcast_convert_type(bits, fdt), cnt > 0))
+        else:   # "ext"
+            outs.append((comp[slot], cnt > 0))
+
+    return num_groups, starts, first_rows, outs
 
 
 def groupby_aggregate(table: Table,
@@ -225,9 +304,10 @@ def groupby_aggregate(table: Table,
             agg_valids.append(c.validity)
         agg_kinds.append(op)
 
-    num_groups, first_sorted, order, outs = _groupby_kernel(
+    num_groups, first_sorted, first_rows_full, outs = _groupby_kernel(
         tuple(operands), tuple(agg_datas), tuple(agg_valids),
-        n_ops=len(operands), agg_kinds=tuple(agg_kinds))
+        n_ops=len(operands), agg_kinds=tuple(agg_kinds),
+        has_valids=tuple(v is not None for v in agg_valids))
     if _cap is None:
         g = int(num_groups)  # the one host sync
     else:
@@ -235,12 +315,13 @@ def groupby_aggregate(table: Table,
         # must accept small batches, and a too-small cap must be retryable
         # with a bigger one regardless of n)
         g = min(_cap, n)
-    # padded first_sorted entries hold n: clip for the gather — rows past
-    # num_groups are garbage by contract, masked by the capped valid vector
+    # padded entries hold n: clip for the gathers — rows past num_groups are
+    # garbage by contract, masked by the capped valid vector
     first_sorted = jnp.clip(first_sorted, 0, max(n - 1, 0))
 
-    # key columns: row index (original frame) of each group's first sorted row
-    first_rows = jnp.take(order, first_sorted[:g], axis=0)
+    # key columns: row index (original frame) of each group's first sorted
+    # row — carried straight through the compaction sort, no order gather
+    first_rows = jnp.clip(first_rows_full[:g], 0, max(n - 1, 0))
     # first_rows is non-negative by construction: skip take()'s any<0 sync
     out_cols = [take(c, first_rows, _has_negative=False) for c in keys]
     names = [table.names[k] if isinstance(k, int) else k for k in key_names]

@@ -34,6 +34,13 @@ behaves identically (huge positive → overflow/null via the zero-padding
 check, huge negative → all digits insignificant → 0) while `dl + e` can
 no longer wrap int64 (an exponent like 9e9223372036854775807 previously
 wrapped to a *valid 0* instead of null).
+
+Known deviation (zero mantissa, huge positive exponent): '0e<big>' nulls
+here via the zeros-to-decimal ≤ 39 cap, while the reference's padding loop
+on a zero value never overflows and yields a valid 0. Spark itself parses
+the exponent as a Java int inside BigDecimal, so the null (cast failure)
+matches Spark's observable behavior; this is intentional and cemented by a
+regression test.
 """
 from __future__ import annotations
 

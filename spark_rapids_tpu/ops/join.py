@@ -4,25 +4,39 @@
 gather maps the plugin applies — the same contract here).
 
 TPU-first design: device hash tables fight the hardware (scatter-heavy,
-dynamic occupancy); XLA's sorter + searchsorted are native. The join is:
+dynamic occupancy); XLA's sorter + scans are native. Round-4 redesign is
+SCATTER-FREE end to end — the round-2 on-chip numbers (tools/primitives
+sweep + docs/architecture.md) put a random scatter at ~930 ms for 10M rows
+under x64 emulation while a 2-operand int32 sort is ~40 ms and a cumsum
+~16 ms, and the previous pipeline spent three scatters per join. The join
+is ONE union sort + scans + two small routing sorts:
 
-1. union-rank the keys: concatenate left+right key columns, ONE
-   multi-operand `lax.sort` over their orderable operands (shared with
-   ops/sort.py, so cross-type normalization — NaN, -0.0, decimal limbs,
-   string words — is consistent), run-boundary prefix-sum → every row gets a
-   dense int32 rank; equal keys ⇔ equal ranks. This reduces any multi-column,
-   any-dtype equi-join to an int32 join.
-2. sort-merge the spans: two combined (rank, side) sorts give every left
-   row its [lo, hi) match span in the rank-sorted right side (counts of
-   right ranks < / <= each left rank) — no binary search, which would
-   lower to ~log2(n) whole-array gather passes on TPU.
-3. expand: exclusive-scan the counts, then jnp.repeat (cumsum + scatter
-   under the hood) recovers (left row, k-th match) for every output slot.
-   Both sides come back as gather maps; -1 marks outer-join non-matches
-   (take() turns them into null rows).
+1. union sort: concatenate left+right key columns, ONE multi-operand
+   `lax.sort` over their orderable operands (shared with ops/sort.py, so
+   cross-type normalization — NaN, -0.0, decimal limbs, string words — is
+   consistent), carrying two payloads: the row iota and a "matchable right
+   row" flag. Equal keys form runs.
+2. in-sort span computation: a cumsum of the matchable flag gives, at each
+   sorted position, the count of matchable right rows at or before it.
+   Every row's match span in "matchable-right union order" is then
+       lo = exclusive count at its run START (forward segmented copy)
+       hi = inclusive count at its run END   (reverse segmented copy)
+   — two `lax.associative_scan`s, no searchsorted (which lowers to
+   ~log2(n) whole-array gather passes on TPU, ~2 s at 10M).
+3. routing sorts: `lo`/`hi` ride ONE inverse-permutation sort (keyed by the
+   iota payload) back to original row order — a permutation scatter would
+   be ~20x slower on-chip. The right-side gather map targets come from one
+   boundary-compaction sort that packs matchable right rows (in union
+   order) to the front.
+4. expand: exclusive-scan the counts, then jnp.repeat (cumsum + a
+   sorted-unique scatter under the hood) recovers (left row, k-th match)
+   for every output slot. Both sides come back as gather maps; -1 marks
+   outer-join non-matches (take() turns them into null rows).
 
 Null keys never match (Spark equi-join); null-safe equality (<=>) is the
-`null_equal` flag, like cudf's null_equality::EQUAL.
+`null_equal` flag, like cudf's null_equality::EQUAL — null rows get their
+own leading rank operand (ops/sort.py), so they form their own runs and
+match each other exactly when the validity masks say they may.
 """
 from __future__ import annotations
 
@@ -50,72 +64,76 @@ def _concat_columns(a: Column, b: Column) -> Column:
         raise TypeError(f"join key {e}") from None
 
 
-@partial(jax.jit, static_argnames=("n_ops",))
-def _union_ranks(operands, *, n_ops: int) -> jnp.ndarray:
-    """Dense rank per row: equal operand tuples ⇔ equal rank."""
+def _seg_copy(flag, vals):
+    """Per position: `vals` at the most recent flagged position (forward).
+    Positions before the first flag keep vals[0]; callers guarantee
+    flag[0] is True. The 'latest flagged value' combine is associative, so
+    this is one log-depth associative_scan, not a sequential loop."""
+    def combine(a, b):
+        ab, av = a
+        bb, bv = b
+        return ab | bb, jnp.where(bb, bv, av)
+    return jax.lax.associative_scan(combine, (flag, vals))[1]
+
+
+def _seg_copy_rev(flag, vals):
+    """Per position: `vals` at the nearest flagged position at-or-after it
+    (reverse segmented copy); callers guarantee flag[-1] is True."""
+    def combine(a, b):
+        ab, av = a
+        bb, bv = b
+        return ab | bb, jnp.where(bb, bv, av)
+    return jax.lax.associative_scan(combine, (flag, vals), reverse=True)[1]
+
+
+@partial(jax.jit, static_argnames=("n_ops", "nl", "need_rorder"))
+def _join_kernel(operands, lvalid, rvalid, *, n_ops: int, nl: int,
+                 need_rorder: bool):
+    """Scatter-free span computation over the union sort.
+
+    Returns (counts, lo, rorder) in ORIGINAL left-row order:
+      counts[i] — number of matching (valid) right rows for left row i
+      lo[i]     — first match position in `rorder`
+      rorder    — matchable right-row ids packed to the front, union-sorted
+                  (length n union frame; entries past the matchable count
+                  are n and never addressed: hi <= matchable count)
+    """
     n = operands[0].shape[0]
+    nr = n - nl
     iota = jnp.arange(n, dtype=jnp.int32)
-    out = jax.lax.sort([*operands, iota], num_keys=n_ops, is_stable=True)
-    sorted_ops, order = out[:-1], out[-1]
+    # matchable = valid right row; carried as a sort payload (a marginal
+    # sort operand is ~4x cheaper on-chip than a post-sort gather)
+    matchable = jnp.concatenate([jnp.zeros((nl,), jnp.int32),
+                                 rvalid.astype(jnp.int32)])
+    out = jax.lax.sort([*operands, iota, matchable], num_keys=n_ops,
+                       is_stable=True)
+    sorted_ops, order, m_s = out[:-2], out[-2], out[-1]
+
     neq = jnp.zeros((n,), bool)
     for o in sorted_ops:
         neq = neq | (o != jnp.roll(o, 1))
-    if n:
-        neq = neq.at[0].set(False)                 # guard: empty scatter OOB
-    gid = jnp.cumsum(neq.astype(jnp.int32))
-    # scatter back to original row order
-    ranks = jnp.zeros((n,), jnp.int32).at[order].set(gid)
-    return ranks
+    boundary = neq.at[0].set(True) if n else neq   # guard: empty scatter OOB
+    ends = jnp.roll(boundary, -1).at[-1].set(True) if n else boundary
 
+    rcnt = jnp.cumsum(m_s)                       # inclusive matchable count
+    excl = rcnt - m_s
+    lo_pos = _seg_copy(boundary, excl)           # lo of each row's run
+    hi_pos = _seg_copy_rev(ends, rcnt)           # hi of each row's run
 
-@jax.jit
-def _match_spans(lrank, lvalid, rrank, rvalid):
-    """Per-left-row [lo, hi) span of matching rows in the rank-sorted right
-    side, plus that sorted right order. Invalid (null-key) rows never match.
+    # route lo/hi back to original row order: ONE 3-operand sort keyed by
+    # the iota payload (order is a permutation, so this inverts it)
+    routed = jax.lax.sort([order, lo_pos, hi_pos], num_keys=1)
+    lo_orig, hi_orig = routed[1][:nl], routed[2][:nl]
+    counts = jnp.where(lvalid, hi_orig - lo_orig, 0)
 
-    Sort-merge, not binary search: jnp.searchsorted lowers to ~log2(n)
-    whole-array gather passes on TPU (~1.6s at 10M×1M), while lax.sort +
-    cumsum + one int32 scatter are each tens of ms. Both span endpoints come
-    from ONE combined sort each:
-
-      hi[i] = #right rows with rank <= lrank[i]  → sort (rank, side) with
-              right-before-left on ties; prefix-count of right entries at
-              each left row's sorted position
-      lo[i] = #right rows with rank <  lrank[i]  → same with left first
-    """
-    nl = lrank.shape[0]
-    nr = rrank.shape[0]
-    big = jnp.int32(2**31 - 1)
-    rkey = jnp.where(rvalid, rrank, big)      # null-key right rows at the end
-    rorder_out = jax.lax.sort([rkey, jnp.arange(nr, dtype=jnp.int32)],
-                              num_keys=1, is_stable=True)
-    rorder = rorder_out[1]
-
-    keys = jnp.concatenate([lrank, rkey])
-    payload = jnp.arange(nl + nr, dtype=jnp.int32)   # <nl: left row id
-
-    def spans(left_tie_flag):
-        # ties: smaller flag sorts first
-        flags = jnp.concatenate([
-            jnp.full((nl,), left_tie_flag, jnp.int32),
-            jnp.full((nr,), 1 - left_tie_flag, jnp.int32)])
-        k_s, f_s, p_s = jax.lax.sort([keys, flags, payload], num_keys=2,
-                                     is_stable=True)
-        is_right = f_s == (1 - left_tie_flag)
-        rcount = jnp.cumsum(is_right.astype(jnp.int32))  # inclusive
-        # count of right entries strictly BEFORE each position
-        before = rcount - is_right.astype(jnp.int32)
-        # route each position's count back to its original row
-        out = jnp.zeros((nl + nr,), jnp.int32).at[p_s].set(before)
-        return out[:nl]
-
-    hi = spans(1)                 # right first on ties: counts rank <= lrank
-    lo = spans(0)                 # left first on ties:  counts rank <  lrank
-    n_valid = jnp.sum(rvalid.astype(jnp.int32))
-    hi = jnp.minimum(hi, n_valid)                    # exclude null-key rights
-    lo = jnp.minimum(lo, hi)
-    counts = jnp.where(lvalid, hi - lo, 0)
-    return counts, lo, rorder
+    if need_rorder:
+        # pack matchable right-row ids (union-sorted order) to the front
+        flag = jnp.where(m_s == 1, jnp.int32(0), jnp.int32(1))
+        rid = jnp.where(m_s == 1, order - nl, jnp.int32(n))
+        rorder = jax.lax.sort([flag, rid], num_keys=1, is_stable=True)[1]
+    else:
+        rorder = jnp.zeros((0,), jnp.int32) if nr == 0 else iota[:0]
+    return counts, lo_orig, rorder
 
 
 @partial(jax.jit, static_argnames=("total", "outer"))
@@ -124,8 +142,9 @@ def _expand(counts, lo, rorder, *, total: int, outer: bool):
     eff = jnp.maximum(counts, 1) if outer else counts
     starts = jnp.cumsum(eff) - eff            # exclusive scan
     # which left row produced output slot j: repeat row ids by their counts
-    # (jnp.repeat with a static total lowers to cumsum+scatter+max-scan —
-    # no per-slot binary search)
+    # (jnp.repeat with a static total lowers to cumsum + a sorted-unique
+    # scatter + max-scan — no per-slot binary search, and sorted-unique
+    # scatter is the one fast scatter form on-chip)
     lsel = jnp.repeat(jnp.arange(nl, dtype=jnp.int32), eff,
                       total_repeat_length=total)
     j = jnp.arange(total, dtype=jnp.int32)
@@ -140,7 +159,7 @@ def _expand(counts, lo, rorder, *, total: int, outer: bool):
     return lsel, rmap
 
 
-def _prep(left_keys, right_keys, null_equal: bool):
+def _prep(left_keys, right_keys, null_equal: bool, need_rorder: bool = True):
     lcols, rcols = list(left_keys), list(right_keys)
     if len(lcols) != len(rcols) or not lcols:
         raise ValueError("join requires equal, nonzero key column counts")
@@ -152,8 +171,6 @@ def _prep(left_keys, right_keys, null_equal: bool):
         u = _concat_columns(a, b)
         union_ops.extend(_key_operands(u, True, None))
     nl = lcols[0].length
-    ranks = _union_ranks(tuple(union_ops), n_ops=len(union_ops))
-    lrank, rrank = ranks[:nl], ranks[nl:]
 
     def side_valid(cols, n):
         v = jnp.ones((n,), bool)
@@ -166,7 +183,8 @@ def _prep(left_keys, right_keys, null_equal: bool):
 
     lvalid = side_valid(lcols, nl)
     rvalid = side_valid(rcols, rcols[0].length)
-    return lrank, lvalid, rrank, rvalid
+    return _join_kernel(tuple(union_ops), lvalid, rvalid,
+                        n_ops=len(union_ops), nl=nl, need_rorder=need_rorder)
 
 
 def _cols(keys) -> Sequence[Column]:
@@ -180,9 +198,7 @@ def _cols(keys) -> Sequence[Column]:
 def inner_join(left_keys, right_keys,
                null_equal: bool = False) -> Tuple[Column, Column]:
     """Gather maps (left_map, right_map) of the inner equi-join."""
-    lrank, lvalid, rrank, rvalid = _prep(_cols(left_keys), _cols(right_keys),
-                                         null_equal)
-    counts, lo, rorder = _match_spans(lrank, lvalid, rrank, rvalid)
+    counts, lo, rorder = _prep(_cols(left_keys), _cols(right_keys), null_equal)
     total = int(jnp.sum(counts))              # the one host sync
     lmap, rmap = _expand(counts, lo, rorder, total=total, outer=False)
     return (Column(dtype=dtypes.INT32, length=total, data=lmap),
@@ -193,9 +209,7 @@ def left_join(left_keys, right_keys,
               null_equal: bool = False) -> Tuple[Column, Column]:
     """Left outer join: every left row appears; non-matches get right -1
     (take() nullifies)."""
-    lrank, lvalid, rrank, rvalid = _prep(_cols(left_keys), _cols(right_keys),
-                                         null_equal)
-    counts, lo, rorder = _match_spans(lrank, lvalid, rrank, rvalid)
+    counts, lo, rorder = _prep(_cols(left_keys), _cols(right_keys), null_equal)
     total = int(jnp.sum(jnp.maximum(counts, 1)))
     lmap, rmap = _expand(counts, lo, rorder, total=total, outer=True)
     return (Column(dtype=dtypes.INT32, length=total, data=lmap),
@@ -205,9 +219,8 @@ def left_join(left_keys, right_keys,
 def left_semi_join(left_keys, right_keys,
                    null_equal: bool = False) -> Column:
     """Left rows having >=1 match (gather map into the left table)."""
-    lrank, lvalid, rrank, rvalid = _prep(_cols(left_keys), _cols(right_keys),
-                                         null_equal)
-    counts, _, _ = _match_spans(lrank, lvalid, rrank, rvalid)
+    counts, _, _ = _prep(_cols(left_keys), _cols(right_keys), null_equal,
+                         need_rorder=False)
     keep = jnp.nonzero(counts > 0)[0].astype(jnp.int32)
     return Column(dtype=dtypes.INT32, length=int(keep.shape[0]), data=keep)
 
@@ -217,8 +230,7 @@ def left_anti_join(left_keys, right_keys,
     """Left rows having no match — Spark NOT IN/anti join. NB: rows with a
     null key have no match, so they ARE returned (cudf behavior; Spark's
     NOT IN null semantics are built on top by the plugin)."""
-    lrank, lvalid, rrank, rvalid = _prep(_cols(left_keys), _cols(right_keys),
-                                         null_equal)
-    counts, _, _ = _match_spans(lrank, lvalid, rrank, rvalid)
+    counts, _, _ = _prep(_cols(left_keys), _cols(right_keys), null_equal,
+                         need_rorder=False)
     keep = jnp.nonzero(counts == 0)[0].astype(jnp.int32)
     return Column(dtype=dtypes.INT32, length=int(keep.shape[0]), data=keep)

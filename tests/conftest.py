@@ -62,11 +62,30 @@ TPU_SMOKE_PREFIXES = (
 )
 
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _canonical_nodeid(item):
+    """Repo-root-relative nodeid, independent of pytest's rootdir/cwd."""
+    parts = item.nodeid.split("::", 1)
+    rel = os.path.relpath(str(item.fspath), _REPO_ROOT).replace(os.sep, "/")
+    return rel if len(parts) == 1 else rel + "::" + parts[1]
+
+
+def _smoke_match(nid: str) -> bool:
+    # Anchor at node boundaries so "test_rounding" can't claim
+    # "test_rounding_extra": a prefix only matches exactly, or when followed
+    # by a child separator ("::") or a parametrize bracket ("[").
+    for p in TPU_SMOKE_PREFIXES:
+        if nid == p or nid.startswith(p + "::") or nid.startswith(p + "["):
+            return True
+    return False
+
+
 def pytest_collection_modifyitems(config, items):
     import pytest
     for item in items:
-        nid = item.nodeid
-        if any(nid.startswith(p) for p in TPU_SMOKE_PREFIXES):
+        if _smoke_match(_canonical_nodeid(item)):
             item.add_marker(pytest.mark.tpu_smoke)
 
 

@@ -85,6 +85,20 @@ def main(argv=None):
     ap.add_argument("--out", default=os.path.join(ROOT, "BENCH_DETAIL.md"))
     args = ap.parse_args(argv)
 
+    if not args.cpu:
+        # Fail fast on a dead tunnel (<5 s) instead of burning a 600 s
+        # timeout per bench file — same healthz probe as ci/tpu-smoke.sh /
+        # bench.py; exit 75 = EX_TEMPFAIL (infrastructure, not a regression).
+        sys.path.insert(0, ROOT)
+        from bench import probe_tunnel
+        health = probe_tunnel()
+        if health == "dead" and os.environ.get("SRT_BENCH_FORCE_DEVICE", "") != "1":
+            print("capture_bench_detail: axon tunnel healthz dead — refusing "
+                  "an unpinned capture (it would hang). Re-run with --cpu for "
+                  "a CPU capture, or SRT_BENCH_FORCE_DEVICE=1 to override.",
+                  file=sys.stderr)
+            sys.exit(75)
+
     backend = "cpu (pinned)" if args.cpu else "default (TPU when up)"
     lines = [
         "# BENCH_DETAIL — staged-config measurements",
