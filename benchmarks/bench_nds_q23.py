@@ -61,6 +61,15 @@ def build_tables(n_sales: int, seed=0):
 
 def q23(store, sides):
     """The Q23-shaped plan, shared by bench and tests/test_nds_query.py."""
+    return q23_detail(store, sides)["total"]
+
+
+def q23_detail(store, sides):
+    """q23 with its intermediate structure exposed (the subquery-reuse
+    query's whole point is those two shared subqueries): returns
+    {"total", "per_side" [one total per side], "freq_items" Table,
+    "best_cust" Table} so the oracle test can assert each subquery set in
+    isolation — a compensating-error pair across subqueries cannot pass."""
     import jax.numpy as jnp
     from spark_rapids_tpu import Table
     from spark_rapids_tpu.ops import (apply_boolean_mask, groupby_aggregate,
@@ -89,7 +98,8 @@ def q23(store, sides):
         keep2 = left_semi_join([s1["cust_sk"]], [best["cust_sk"]])
         s2 = take_table(s1, keep2.data)
         totals.append(jnp.sum(s2["qty"].data * s2["price"].data))
-    return totals[0] + totals[1]          # (1,)-free scalar jax.Array
+    return {"total": totals[0] + totals[1],   # (1,)-free scalar jax.Array
+            "per_side": totals, "freq_items": freq, "best_cust": best}
 
 
 def _col_from(data):

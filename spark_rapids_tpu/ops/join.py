@@ -50,7 +50,8 @@ from .. import dtypes
 from ..columnar import Column, Table
 from .sort import _key_operands
 
-__all__ = ["inner_join", "left_join", "left_semi_join", "left_anti_join"]
+__all__ = ["inner_join", "left_join", "left_semi_join", "left_anti_join",
+           "join_spans", "expand_spans"]
 
 
 def _concat_columns(a: Column, b: Column) -> Column:
@@ -157,6 +158,28 @@ def _expand(counts, lo, rorder, *, total: int, outer: bool):
         rmap = jnp.take(rorder, jnp.clip(rpos, 0, rorder.shape[0] - 1), axis=0)
         rmap = jnp.where(matched, rmap, -1) if outer else rmap
     return lsel, rmap
+
+
+def join_spans(operands, lvalid, rvalid, *, nl: int, need_rorder: bool = True):
+    """PUBLIC span kernel — the cross-module contract consumed by
+    parallel/relational.py's shard-local join tails (imported at module top
+    there, so a refactor here fails at collection time, not at runtime).
+
+    operands: orderable sort operands of the CONCATENATED left+right keys
+    (raw key words work: the kernel sorts whatever it is given). lvalid
+    (nl,) / rvalid (n-nl,) are the MATCH masks — masked-out left rows get
+    count 0, masked-out right rows are never matched. Returns
+    (counts, lo, rorder) in original left-row order; see _join_kernel."""
+    operands = tuple(operands)
+    return _join_kernel(operands, lvalid, rvalid, n_ops=len(operands),
+                        nl=nl, need_rorder=need_rorder)
+
+
+def expand_spans(counts, lo, rorder, *, total: int, outer: bool = False):
+    """PUBLIC padded span expansion (companion to join_spans): materialize
+    (left row, right row) gather maps into a fixed `total` slots; under
+    `outer` every left row emits >=1 slot and unmatched rows get right -1."""
+    return _expand(counts, lo, rorder, total=total, outer=outer)
 
 
 def _prep(left_keys, right_keys, null_equal: bool, need_rorder: bool = True):

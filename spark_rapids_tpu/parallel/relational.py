@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..ops.join import expand_spans, join_spans
 from .shuffle import build_partition_map, partition_ids
 
 _AGGS = ("sum", "count", "min", "max")
@@ -374,7 +375,6 @@ def _local_join_tail(lk, lv, lalive, rk, rv, ralive, row_cap: int,
     Returns (lkeys list, lvals list, rvals list, rmatched, live,
     overflow-scalar); rmatched is False on left-outer rows with no match
     (their rval slots are 0 and must be read as null)."""
-    from ..ops.join import _expand, _match_spans, _union_ranks
     lks, rks = _as_list(lk), _as_list(rk)
     lvs, rvs = _as_list(lv), _as_list(rv)
     lmatch = lalive if lmatch is None else lmatch
@@ -390,9 +390,8 @@ def _local_join_tail(lk, lv, lalive, rk, rv, ralive, row_cap: int,
         lalive = jnp.take(lalive, order, axis=0)
         lmatch = jnp.take(lmatch, order, axis=0)
     operands = tuple(jnp.concatenate([a, b]) for a, b in zip(lks, rks))
-    ranks = _union_ranks(operands, n_ops=len(operands))
-    counts, lo, rorder = _match_spans(ranks[:nl], lmatch, ranks[nl:], rmatch)
-    lsel, rsel = _expand(counts, lo, rorder, total=row_cap, outer=outer)
+    counts, lo, rorder = join_spans(operands, lmatch, rmatch, nl=nl)
+    lsel, rsel = expand_spans(counts, lo, rorder, total=row_cap, outer=outer)
     if outer:
         total = jnp.sum(jnp.where(lalive, jnp.maximum(counts, 1), 0))
     else:
@@ -606,7 +605,6 @@ def distributed_left_join(mesh: Mesh, lkeys: jnp.ndarray, lvals: jnp.ndarray,
 def _distributed_semi_anti(mesh, lkeys, lvals, rkeys, semi, slack, axis):
     """Shared body: mark each left row matched/unmatched after the exchange;
     output stays left-shaped (no expansion, no row_cap)."""
-    from ..ops.join import _match_spans, _union_ranks
     n_peers = mesh.shape[axis]
 
     def local(lk, lv, rk):
@@ -615,8 +613,8 @@ def _distributed_semi_anti(mesh, lkeys, lvals, rkeys, semi, slack, axis):
         (Rk,), _, Ralive, rspill = _hash_exchange(
             axis, n_peers, slack, rk, None)
         nl = Lk.shape[0]
-        ranks = _union_ranks((jnp.concatenate([Lk, Rk]),), n_ops=1)
-        counts, _, _ = _match_spans(ranks[:nl], Lalive, ranks[nl:], Ralive)
+        counts, _, _ = join_spans((jnp.concatenate([Lk, Rk]),),
+                                  Lalive, Ralive, nl=nl, need_rorder=False)
         hit = counts > 0
         keep = Lalive & (hit if semi else ~hit)
         out_lk = jnp.where(keep, Lk, 0)
@@ -635,7 +633,6 @@ def _distributed_semi_anti_keyed(mesh, l_words, lvals, r_words, key_specs,
     """Typed-key shared body: keys as word lists, same marking logic.
     NULL keys never match (Spark equi-join semantics): a null-keyed left
     row is dropped by semi and kept by anti."""
-    from ..ops.join import _match_spans, _union_ranks
     from .keys import keys_null_mask, spark_partition_hash
     n_peers = mesh.shape[axis]
     hash_fn = lambda ws: spark_partition_hash(ws, key_specs)  # noqa: E731
@@ -656,8 +653,8 @@ def _distributed_semi_anti_keyed(mesh, l_words, lvals, r_words, key_specs,
         rmatch = Ralive & ~keys_null_mask(Rw, key_specs)
         nl = Lw[0].shape[0]
         operands = tuple(jnp.concatenate([a, b]) for a, b in zip(Lw, Rw))
-        ranks = _union_ranks(operands, n_ops=len(operands))
-        counts, _, _ = _match_spans(ranks[:nl], lmatch, ranks[nl:], rmatch)
+        counts, _, _ = join_spans(operands, lmatch, rmatch, nl=nl,
+                                  need_rorder=False)
         hit = counts > 0
         keep = Lalive & (hit if semi else ~hit)
         out_lw = [jnp.where(keep, w, 0) for w in Lw]

@@ -76,8 +76,15 @@ def _smoke_match(nid: str) -> bool:
     # Anchor at node boundaries so "test_rounding" can't claim
     # "test_rounding_extra": a prefix only matches exactly, or when followed
     # by a child separator ("::") or a parametrize bracket ("[").
+    # A prefix that already contains an unclosed "[" is an intentionally
+    # partial parametrize match (e.g. "...[dtype0" claims "[dtype0-64-...]"):
+    # anchoring would require "::"/"[" right after and silently drop it, so
+    # it matches as a raw startswith instead.
     for p in TPU_SMOKE_PREFIXES:
-        if nid == p or nid.startswith(p + "::") or nid.startswith(p + "["):
+        if "[" in p and "]" not in p:
+            if nid.startswith(p):
+                return True
+        elif nid == p or nid.startswith(p + "::") or nid.startswith(p + "["):
             return True
     return False
 

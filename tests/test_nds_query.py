@@ -84,11 +84,14 @@ def test_nds_q5_pipeline_matches_pandas():
 
 
 def test_nds_q23_pipeline_matches_pandas():
+    # structure-level parity, not one scalar: each shared subquery SET and
+    # each side's total are asserted in isolation, so a compensating-error
+    # pair (e.g. freq too big, best too small) cannot pass
     from benchmarks.bench_nds_q23 import (BEST_FRACTION, FREQ_THRESHOLD,
-                                          _datagen, build_tables, q23)
+                                          _datagen, build_tables, q23_detail)
     n_sales = 30_000
     store, sides = build_tables(n_sales, seed=11)
-    got = int(q23(store, sides))
+    detail = q23_detail(store, sides)
 
     s, sd = _datagen(n_sales, seed=11)
     sdf = pd.DataFrame(s)
@@ -97,12 +100,21 @@ def test_nds_q23_pipeline_matches_pandas():
     sdf["rev"] = sdf.qty * sdf.price
     by_cust = sdf.groupby("cust_sk").rev.sum()
     best = set(by_cust[by_cust > BEST_FRACTION * by_cust.max()].index)
+
+    got_freq = set(detail["freq_items"]["item_sk"].to_pylist())
+    got_best = set(detail["best_cust"]["cust_sk"].to_pylist())
+    assert got_freq == freq_items         # subquery 1 exact set parity
+    assert got_best == best               # subquery 2 exact set parity
+    assert len(freq_items) > 0 and len(best) > 0
+
     total = 0
-    for side in sd.values():
-        df = pd.DataFrame(side)
+    for side_name, per_side in zip(sd, detail["per_side"]):
+        df = pd.DataFrame(sd[side_name])
         df = df[df.item_sk.isin(freq_items) & df.cust_sk.isin(best)]
-        total += int((df.qty * df.price).sum())
-    assert got == total
+        side_total = int((df.qty * df.price).sum())
+        assert int(per_side) == side_total, side_name   # per-side totals
+        total += side_total
+    assert int(detail["total"]) == total
     assert total > 0                      # the HAVING clauses selected rows
 
 
