@@ -107,17 +107,64 @@ def _col_from(data):
     return Column(dtype=dtypes.INT64, length=data.shape[0], data=data)
 
 
+def q23_capped(store, sides, key_cap_items: int = 4096,
+               key_cap_cust: int = 8192):
+    """q23 as ONE jit-traceable XLA program. Both HAVING subqueries run as
+    capped groupbys whose predicate becomes an `alive` mask over the padded
+    group table; the IN-filters (semi joins) become semi_join_mask with
+    that alive mask as `ralive` — the filtered side never materializes.
+    Returns {"total", "per_side", "freq_alive", "best_alive", "freq_keys",
+    "best_keys", "overflow"} (same structure q23_detail exposes eagerly)."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu import Table
+    from spark_rapids_tpu.ops import (groupby_aggregate_capped,
+                                      semi_join_mask)
+
+    by_item, iv, o1 = groupby_aggregate_capped(
+        store, ["item_sk"], [("qty", "count")], key_cap=key_cap_items)
+    freq = Table(list(by_item), names=["item_sk", "cnt"])
+    freq_alive = iv & (freq["cnt"].data > FREQ_THRESHOLD)
+
+    rev = store["qty"].data * store["price"].data
+    store2 = Table(list(store.columns) + [_col_from(rev)],
+                   names=list(store.names) + ["rev"])
+    by_cust, cv, o2 = groupby_aggregate_capped(
+        store2, ["cust_sk"], [("rev", "sum")], key_cap=key_cap_cust)
+    best = Table(list(by_cust), names=["cust_sk", "rev"])
+    revs = best["rev"].data
+    max_rev = jnp.max(jnp.where(cv, revs, jnp.iinfo(jnp.int64).min))
+    best_alive = cv & (revs.astype(jnp.float64) >
+                       BEST_FRACTION * max_rev.astype(jnp.float64))
+
+    totals = []
+    for name in ("catalog", "web"):       # dict order is not jit-stable
+        side = sides[name]
+        hit = (semi_join_mask([side["item_sk"]], [freq["item_sk"]],
+                              ralive=freq_alive) &
+               semi_join_mask([side["cust_sk"]], [best["cust_sk"]],
+                              ralive=best_alive))
+        totals.append(jnp.sum(jnp.where(
+            hit, side["qty"].data * side["price"].data, 0)))
+    return {"total": totals[0] + totals[1], "per_side": totals,
+            "freq_alive": freq_alive, "best_alive": best_alive,
+            "freq_keys": freq["item_sk"].data, "best_keys": best["cust_sk"].data,
+            "overflow": o1 | o2}
+
+
 def main(argv=None):
     args = parse_args(argv)
     n_sales = max(int(10_000_000 * args.scale), 8192)
     store, sides = build_tables(n_sales)
     n_total = store.num_rows + sum(t.num_rows for t in sides.values())
 
-    run_config("nds_q23_pipeline", {"num_rows": n_total},
-               lambda s, c, w: q23(s, {"catalog": c, "web": w}),
+    def run(s, c, w):
+        r = q23_capped(s, {"catalog": c, "web": w})
+        return r["total"], r["overflow"]
+
+    run_config("nds_q23_pipeline", {"num_rows": n_total}, run,
                (store, sides["catalog"], sides["web"]),
                n_rows=n_total, iters=args.iters,
-               jit=False)   # semi-join output sizes are data-dependent
+               jit=True)    # capped static-shape tier: one XLA program
 
 
 if __name__ == "__main__":

@@ -80,15 +80,61 @@ def q3(sales, dates, items):
                       ascending=[True, False])
 
 
+def q3_capped(sales, dates, items, key_cap: int = 4096):
+    """q3 as ONE jit-traceable XLA program (the engine the bench measures —
+    per-op eager dispatch is not the deployed form): dim filters become
+    match MASKS (a predicate costs one AND, not a compaction), both star
+    joins run capped (row_cap = n_sales exactly, since date_sk/item_sk are
+    unique build keys: each sale matches at most one dim row), the groupby
+    excludes dead join slots via `alive`, and the presentation sort sinks
+    dead groups. Returns (Table padded to key_cap, valid, overflow) —
+    the SplitAndRetry contract shared with parallel/relational.py."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu import Table
+    from spark_rapids_tpu.ops import (groupby_aggregate_capped,
+                                      inner_join_capped, sort_table_capped,
+                                      take)
+    n = sales.num_rows
+    dmask = dates["d_moy"].data == 11
+    imask = items["i_manufact"].data == 42
+    lm1, rm1, v1, o1 = inner_join_capped(
+        [sales["sold_date_sk"]], [dates["d_date_sk"]], row_cap=n,
+        ralive=dmask)
+    item_sk = take(sales["item_sk"], lm1, _has_negative=False)
+    lm2, rm2, v2, o2 = inner_join_capped(
+        [item_sk], [items["i_item_sk"]], row_cap=n, lalive=v1, ralive=imask)
+    # compose the int32 gather maps once, then fetch each payload column
+    # with ONE n-length gather (not one per join level)
+    sales2 = jnp.take(lm1, lm2, axis=0)
+    dates2 = jnp.take(rm1, lm2, axis=0)
+    year = take(dates["d_year"], dates2, _has_negative=False)
+    price = take(sales["price_cents"], sales2, _has_negative=False)
+    brand = take(items["i_brand"], rm2, _has_negative=False)
+    j2 = Table([year, brand, price],
+               names=["d_year", "i_brand", "price_cents"])
+    agg, gvalid, o3 = groupby_aggregate_capped(
+        j2, ["d_year", "i_brand"], [("price_cents", "sum")],
+        key_cap=key_cap, alive=v2)
+    out = Table(list(agg), names=["d_year", "i_brand", "revenue"])
+    out, svalid = sort_table_capped(out, key_names=["d_year", "revenue"],
+                                    ascending=[True, False], alive=gvalid)
+    return out, svalid, o1 | o2 | o3
+
+
 def main(argv=None):
     args = parse_args(argv)
     n_sales = max(int(10_000_000 * args.scale), 8192)
     sales, dates, items = build_tables(n_sales)
 
     run_config("nds_q3_pipeline", {"num_sales": n_sales},
-               lambda s, d, i: [c.data for c in q3(s, d, i).columns],
+               lambda s, d, i: jax_flatten(q3_capped(s, d, i)),
                (sales, dates, items), n_rows=n_sales, iters=args.iters,
-               jit=False)   # join output sizes are data-dependent
+               jit=True)    # capped static-shape tier: one XLA program
+
+
+def jax_flatten(res):
+    out, valid, overflow = res
+    return [c.data for c in out.columns], valid, overflow
 
 
 if __name__ == "__main__":

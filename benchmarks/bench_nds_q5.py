@@ -67,10 +67,35 @@ def build_tables(n_sales: int, seed=0):
     return tabs, dates
 
 
+def _const(n, v):
+    import jax.numpy as jnp
+    from spark_rapids_tpu import Column, dtypes
+    return Column(dtype=dtypes.INT64, length=n,
+                  data=jnp.full((n,), v, jnp.int64))
+
+
+def _union_channel(sales, returns):
+    """UNION ALL of one channel: sales rows carry (price, profit, 0, 0);
+    returns carry (0, 0, amt, loss) — the q5 ssr/csr/wsr pattern. Shared by
+    the eager and capped plans so their row-for-row parity test compares
+    identical inputs."""
+    from spark_rapids_tpu import Table
+    from spark_rapids_tpu.ops import concat_tables
+    ns, nr = sales.num_rows, returns.num_rows
+    s_rows = Table([sales["sk"], sales["date_sk"], sales["sales_price"],
+                    sales["profit"], _const(ns, 0), _const(ns, 0)],
+                   names=["sk", "date_sk", "sales", "profit",
+                          "returns", "loss"])
+    r_rows = Table([returns["sk"], returns["date_sk"], _const(nr, 0),
+                    _const(nr, 0), returns["return_amt"],
+                    returns["net_loss"]],
+                   names=s_rows.names)
+    return concat_tables([s_rows, r_rows])
+
+
 def q5(tabs, dates):
     """The Q5-shaped plan, shared by bench and tests/test_nds_query.py."""
-    import jax.numpy as jnp
-    from spark_rapids_tpu import Column, Table, dtypes
+    from spark_rapids_tpu import Table
     from spark_rapids_tpu.ops import (apply_boolean_mask, concat_tables,
                                       groupby_aggregate, inner_join,
                                       sort_table, take_table)
@@ -78,25 +103,11 @@ def q5(tabs, dates):
     dwin = apply_boolean_mask(
         dates, (dates["d_date_sk"].data >= DATE_LO) &
                (dates["d_date_sk"].data < DATE_HI))
-
-    def const(n, v):
-        return Column(dtype=dtypes.INT64, length=n,
-                      data=jnp.full((n,), v, jnp.int64))
+    const = _const
 
     per_channel = []
     for ci, (name, (sales, returns)) in enumerate(tabs.items()):
-        ns, nr = sales.num_rows, returns.num_rows
-        # UNION ALL: sales rows carry (price, profit, 0, 0); returns carry
-        # (0, 0, amt, loss) — the q5 ssr/csr/wsr pattern
-        s_rows = Table([sales["sk"], sales["date_sk"], sales["sales_price"],
-                        sales["profit"], const(ns, 0), const(ns, 0)],
-                       names=["sk", "date_sk", "sales", "profit",
-                              "returns", "loss"])
-        r_rows = Table([returns["sk"], returns["date_sk"], const(nr, 0),
-                        const(nr, 0), returns["return_amt"],
-                        returns["net_loss"]],
-                       names=s_rows.names)
-        u = concat_tables([s_rows, r_rows])
+        u = _union_channel(sales, returns)
         lm, _ = inner_join([u["date_sk"]], [dwin["d_date_sk"]])
         uf = take_table(u, lm.data)
         agg = groupby_aggregate(uf, ["sk"],
@@ -128,19 +139,81 @@ def q5(tabs, dates):
                       ascending=[True, False])
 
 
+def q5_capped(tabs, dates, key_cap: int = 2048):
+    """q5 as ONE jit-traceable XLA program. The date-window join becomes a
+    semi-join MASK feeding the groupby's `alive` (d_date_sk is unique, so
+    the inner join to the window IS a row filter — the plan a CBO picks);
+    per-channel groupbys run capped; the channel/grand-total rollup
+    groupbys run over the concatenated PADDED channel outputs (static
+    shapes) with the concatenated valid masks as `alive`. Returns
+    (Table padded to 16 rollup rows, valid, overflow)."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu import Table
+    from spark_rapids_tpu.ops import (concat_tables,
+                                      groupby_aggregate_capped,
+                                      semi_join_mask, sort_table_capped)
+
+    win = ((dates["d_date_sk"].data >= DATE_LO) &
+           (dates["d_date_sk"].data < DATE_HI))
+    const = _const
+
+    sums = [("sales", "sum"), ("returns", "sum"), ("profit", "sum"),
+            ("loss", "sum")]
+    per, pervalid = [], []
+    overflow = jnp.asarray(False)
+    # fixed channel order: a dict passed through jax.jit is rebuilt with
+    # SORTED keys, so enumerate(tabs.items()) would renumber the channels
+    # under jit vs eager
+    channels = [k for k in ("store", "catalog", "web") if k in tabs]
+    channels += [k for k in tabs if k not in channels]
+    for ci, name in enumerate(channels):
+        sales, returns = tabs[name]
+        u = _union_channel(sales, returns)
+        alive = semi_join_mask([u["date_sk"]], [dates["d_date_sk"]],
+                               ralive=win)
+        agg, gvalid, ovf = groupby_aggregate_capped(u, ["sk"], sums,
+                                                    key_cap=key_cap,
+                                                    alive=alive)
+        g = Table(list(agg), names=["sk", "sales", "returns", "profit",
+                                    "loss"])
+        g = Table([const(key_cap, ci)] + list(g.columns),
+                  names=["channel"] + list(g.names))
+        per.append(g)
+        pervalid.append(gvalid)
+        overflow = overflow | ovf
+
+    allch = concat_tables(per)
+    av = jnp.concatenate(pervalid)
+    by_chan, cvalid, o2 = groupby_aggregate_capped(allch, ["channel"], sums,
+                                                   key_cap=8, alive=av)
+    sub = Table(list(by_chan), names=["channel", "sales", "returns",
+                                      "profit", "loss"])
+    allc = Table([const(allch.num_rows, -1)] + list(allch.columns)[2:],
+                 names=sub.names)
+    total, tvalid, o3 = groupby_aggregate_capped(allc, ["channel"], sums,
+                                                 key_cap=8, alive=av)
+    rollup = concat_tables([sub, Table(list(total), names=sub.names)])
+    rvalid = jnp.concatenate([cvalid, tvalid])
+    out, svalid = sort_table_capped(rollup, key_names=["channel", "sales"],
+                                    ascending=[True, False], alive=rvalid)
+    return out, svalid, overflow | o2 | o3
+
+
 def main(argv=None):
     args = parse_args(argv)
     n_sales = max(int(10_000_000 * args.scale), 8192)
     tabs, dates = build_tables(n_sales)
     n_total = sum(t.num_rows + r.num_rows for t, r in tabs.values())
 
-    run_config("nds_q5_pipeline", {"num_rows": n_total},
-               lambda *a: [c.data for c in q5(
-                   {k: (a[2 * i], a[2 * i + 1])
-                    for i, k in enumerate(tabs)}, a[-1]).columns],
+    def run(*a):
+        t = {k: (a[2 * i], a[2 * i + 1]) for i, k in enumerate(tabs)}
+        out, valid, overflow = q5_capped(t, a[-1])
+        return [c.data for c in out.columns], valid, overflow
+
+    run_config("nds_q5_pipeline", {"num_rows": n_total}, run,
                tuple(x for pair in tabs.values() for x in pair) + (dates,),
                n_rows=n_total, iters=args.iters,
-               jit=False)   # join output sizes are data-dependent
+               jit=True)    # capped static-shape tier: one XLA program
 
 
 if __name__ == "__main__":

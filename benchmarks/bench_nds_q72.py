@@ -98,15 +98,88 @@ def q72(cs, inv, items, hd, wh, dates):
                       ascending=[False, True, True, True])
 
 
+def q72_capped(cs, inv, items, hd, wh, dates, key_cap: int = 0):
+    """q72 as ONE jit-traceable XLA program. Every dim join has a UNIQUE
+    build key, so row_cap = n_sales is exact for all of them — including
+    inventory, which joins on the COMPOSITE (item, week) key (unique per
+    datagen, one row per combo) instead of eager q72's item-only join +
+    week filter: same rows, no fan-out, the physical plan a CBO picks.
+    Dim filters and the two non-equi residuals are alive-mask ANDs.
+    key_cap=0 means n_sales (groups ≤ live rows: never overflows).
+    Returns (Table padded to key_cap, valid, overflow)."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu import Table
+    from spark_rapids_tpu.ops import (groupby_aggregate_capped,
+                                      inner_join_capped, sort_table_capped,
+                                      take)
+
+    n = cs.num_rows
+    key_cap = key_cap or n
+
+    def g(col, m):
+        return take(col, m, _has_negative=False)
+
+    def comp(a, b):
+        # compose int32 gather maps (dead slots are clamped to 0: in range)
+        return jnp.take(a, b, axis=0)
+
+    hd_mask = hd["hd_buy_potential"].data == 3
+    d1_mask = dates["d_year"].data == 1
+
+    lm1, _, v1, o1 = inner_join_capped(
+        [cs["hd_sk"]], [hd["hd_demo_sk"]], row_cap=n, ralive=hd_mask)
+    item1 = g(cs["item_sk"], lm1)
+    lm2, rm2, v2, o2 = inner_join_capped(
+        [item1], [items["i_item_sk"]], row_cap=n, lalive=v1)
+    cs2 = comp(lm1, lm2)                 # j2 frame -> cs rows
+    sold2 = g(cs["sold_date_sk"], cs2)
+    lm3, rm3, v3, o3 = inner_join_capped(
+        [sold2], [dates["d_date_sk"]], row_cap=n, lalive=v2, ralive=d1_mask)
+    cs3 = comp(cs2, lm3)                 # j3 frame -> cs rows
+    ship3 = g(cs["ship_days"], cs3)
+    v3 = v3 & (ship3.data > 5)                     # date-offset residual
+    item3 = g(items["i_item_sk"], comp(rm2, lm3))
+    week3 = g(dates["d_week"], rm3)
+    lm4, rm4, v4, o4 = inner_join_capped(
+        [item3, week3], [inv["inv_item_sk"], inv["inv_week"]],
+        row_cap=n, lalive=v3)
+    cs4 = comp(cs3, lm4)                 # j4 frame -> cs rows
+    qty4 = g(cs["qty"], cs4)
+    inv_qty4 = g(inv["inv_qty"], rm4)
+    v4 = v4 & (inv_qty4.data < qty4.data)          # short-stock residual
+    inv_wh4 = g(inv["inv_wh_sk"], rm4)
+    lm5, rm5, v5, o5 = inner_join_capped(
+        [inv_wh4], [wh["w_warehouse_sk"]], row_cap=n, lalive=v4)
+
+    j45 = comp(lm4, lm5)                 # j5 frame -> j3 frame
+    jt = Table([g(items["i_item_sk"], comp(comp(rm2, lm3), j45)),
+                g(wh["w_warehouse_sk"], rm5),
+                g(dates["d_week"], comp(rm3, j45)),
+                g(cs["qty"], comp(cs3, j45))],
+               names=["i_item_sk", "w_warehouse_sk", "d_week", "qty"])
+    agg, gvalid, o6 = groupby_aggregate_capped(
+        jt, ["i_item_sk", "w_warehouse_sk", "d_week"], [("qty", "size")],
+        key_cap=key_cap, alive=v5)
+    out = Table(list(agg), names=["i_item_sk", "w_warehouse_sk", "d_week",
+                                  "cnt"])
+    out, svalid = sort_table_capped(
+        out, key_names=["cnt", "i_item_sk", "w_warehouse_sk", "d_week"],
+        ascending=[False, True, True, True], alive=gvalid)
+    return out, svalid, o1 | o2 | o3 | o4 | o5 | o6
+
+
 def main(argv=None):
     args = parse_args(argv)
     n_sales = max(int(10_000_000 * args.scale), 8192)
     tabs = build_tables(n_sales)
 
-    run_config("nds_q72_pipeline", {"num_sales": tabs[0].num_rows},
-               lambda *a: [c.data for c in q72(*a).columns],
+    def run(*a):
+        out, valid, overflow = q72_capped(*a)
+        return [c.data for c in out.columns], valid, overflow
+
+    run_config("nds_q72_pipeline", {"num_sales": tabs[0].num_rows}, run,
                tabs, n_rows=tabs[0].num_rows, iters=args.iters,
-               jit=False)   # join output sizes are data-dependent
+               jit=True)    # capped static-shape tier: one XLA program
 
 
 if __name__ == "__main__":

@@ -28,9 +28,10 @@ from .parse_uri import (parse_uri_to_protocol, parse_uri_to_host,
 from .histogram import create_histogram_if_valid, percentile_from_histogram
 from .map_utils import from_json
 from .gather import take, take_table, apply_boolean_mask
-from .sort import sorted_order, sort_table
+from .sort import sort_table_capped, sorted_order, sort_table
 from .aggregate import groupby_aggregate, groupby_aggregate_capped
-from .join import inner_join, left_join, left_semi_join, left_anti_join
+from .join import (inner_join, inner_join_capped, left_join,
+                   left_semi_join, left_anti_join, semi_join_mask)
 from .copying import (concat_columns, concat_tables, slice_table,
                       split_table, halve_table, replace_nulls, if_else,
                       drop_duplicates)
@@ -66,10 +67,10 @@ _ADMITTED_FACTORS = {
     "create_histogram_if_valid": 2.0, "percentile_from_histogram": 2.0,
     "from_json": 3.0,
     "take": 2.0, "take_table": 2.0, "apply_boolean_mask": 2.0,
-    "sorted_order": 2.0, "sort_table": 3.0,
+    "sorted_order": 2.0, "sort_table": 3.0, "sort_table_capped": 3.0,
     "groupby_aggregate": 2.0, "groupby_aggregate_capped": 2.0,
-    "inner_join": 3.0, "left_join": 3.0, "left_semi_join": 2.0,
-    "left_anti_join": 2.0,
+    "inner_join": 3.0, "inner_join_capped": 3.0, "left_join": 3.0,
+    "left_semi_join": 2.0, "left_anti_join": 2.0, "semi_join_mask": 2.0,
     # slice/split/halve are deliberately NOT admitted: they run inside the
     # SplitAndRetry recovery path when memory is already short, and their
     # pieces replace the parent batch (net-zero new working set) — the
@@ -106,8 +107,10 @@ __all__ = [
     "create_histogram_if_valid", "percentile_from_histogram",
     "from_json",
     "take", "take_table", "apply_boolean_mask", "sorted_order", "sort_table",
+    "sort_table_capped",
     "groupby_aggregate", "groupby_aggregate_capped",
-    "inner_join", "left_join", "left_semi_join", "left_anti_join",
+    "inner_join", "inner_join_capped", "left_join", "left_semi_join",
+    "left_anti_join", "semi_join_mask",
     "concat_columns", "concat_tables", "slice_table", "split_table",
     "halve_table", "replace_nulls", "if_else", "drop_duplicates",
 ]

@@ -50,9 +50,11 @@ def _agg_value_dtype(op: str, dt: dtypes.DType) -> dtypes.DType:
     return dt  # min/max keep the input type
 
 
-@partial(jax.jit, static_argnames=("n_ops", "agg_kinds", "has_valids"))
+@partial(jax.jit,
+         static_argnames=("n_ops", "agg_kinds", "has_valids", "has_alive"))
 def _groupby_kernel(key_operands, agg_datas, agg_valids, *, n_ops: int,
-                    agg_kinds: Tuple[str, ...], has_valids: Tuple[bool, ...]):
+                    agg_kinds: Tuple[str, ...], has_valids: Tuple[bool, ...],
+                    has_alive: bool = False):
     """Scatter-free, gather-free sorted aggregation (round-4 redesign).
 
     On-chip primitive costs (tools/primitives sweep + docs/architecture.md,
@@ -79,6 +81,15 @@ def _groupby_kernel(key_operands, agg_datas, agg_valids, *, n_ops: int,
     Returns (num_groups, starts, first_rows, outs): all n-length, entries
     past num_groups are padding (positions hold n), sliced/masked by the
     caller.
+
+    `has_alive`: key_operands[0] is a dead-row flag (0 alive, 1 dead) the
+    caller prepended — the jit-pipeline contract where upstream capped ops
+    emit padded rows. Dead rows sort LAST (behind every alive group, never
+    mixing with one, since the flag operand differs) and num_groups counts
+    only alive groups, so the caller's `iota < num_groups` mask drops the
+    dead tail for free. Group sizes/aggregates need no special-casing: the
+    group after the last alive group starts exactly where the dead region
+    does, so the adjacent-difference reads stay exact.
     """
     n = key_operands[0].shape[0]
     iota = jnp.arange(n, dtype=jnp.int32)
@@ -108,7 +119,11 @@ def _groupby_kernel(key_operands, agg_datas, agg_valids, *, n_ops: int,
         neq = neq | (o != jnp.roll(o, 1))
     boundary = neq.at[0].set(True) if n else neq   # guard: empty scatter OOB
     ends_flag = jnp.roll(boundary, -1).at[-1].set(True) if n else boundary
-    num_groups = jnp.sum(boundary.astype(jnp.int32))
+    if has_alive:
+        num_groups = jnp.sum((boundary & (sorted_ops[0] == 0))
+                             .astype(jnp.int32))
+    else:
+        num_groups = jnp.sum(boundary.astype(jnp.int32))
 
     def rev_segscan(vals, kind: str):
         """Reverse segmented sum/min/max: resets walking backwards at group
@@ -252,13 +267,16 @@ def _groupby_kernel(key_operands, agg_datas, agg_valids, *, n_ops: int,
 def groupby_aggregate(table: Table,
                       key_names: Sequence[Union[int, str]],
                       aggs: Sequence[Tuple[Union[int, str], str]],
-                      _cap: Optional[int] = None):
+                      _cap: Optional[int] = None,
+                      _alive: Optional[jnp.ndarray] = None):
     """Group by `key_names`, apply `aggs` [(column, op)] with op in
     sum|count|min|max|mean|size. Returns keys + one column per agg, named
     "op(col)". Group order = key sort order (deterministic).
 
     `_cap` is internal (see groupby_aggregate_capped): a static output size
-    that makes the whole aggregation traceable under jax.jit."""
+    that makes the whole aggregation traceable under jax.jit. `_alive` is a
+    (num_rows,) bool excluding padded rows entirely (see
+    groupby_aggregate_capped's `alive`)."""
     keys = [table[k] for k in key_names]
     if not keys:
         raise ValueError("groupby requires at least one key column")
@@ -269,6 +287,10 @@ def groupby_aggregate(table: Table,
     operands = []
     for c in keys:
         operands.extend(_key_operands(c, True, None))
+    if _alive is not None:
+        # leading dead-flag operand: dead rows sort last as their own
+        # groups, counted out of num_groups by the kernel (has_alive)
+        operands = [jnp.where(_alive, jnp.int32(0), jnp.int32(1))] + operands
 
     n = table.num_rows
     agg_datas: List = []
@@ -307,7 +329,8 @@ def groupby_aggregate(table: Table,
     num_groups, first_sorted, first_rows_full, outs = _groupby_kernel(
         tuple(operands), tuple(agg_datas), tuple(agg_valids),
         n_ops=len(operands), agg_kinds=tuple(agg_kinds),
-        has_valids=tuple(v is not None for v in agg_valids))
+        has_valids=tuple(v is not None for v in agg_valids),
+        has_alive=_alive is not None)
     if _cap is None:
         g = int(num_groups)  # the one host sync
     else:
@@ -413,14 +436,21 @@ def _pad_column(col: Column, to: int) -> Column:
 def groupby_aggregate_capped(table: Table,
                              key_names: Sequence[Union[int, str]],
                              aggs: Sequence[Tuple[Union[int, str], str]],
-                             key_cap: int):
+                             key_cap: int,
+                             alive: Optional[jnp.ndarray] = None):
     """Jit-friendly groupby: identical semantics to groupby_aggregate but a
     static `key_cap` output size instead of the group-count host sync, so
     whole pipelines fuse into one XLA program (the same padded contract as
     parallel.distributed_groupby).
 
+    `alive`, if given, is a (num_rows,) bool excluding rows entirely (not
+    null-semantics — the row just isn't there): the contract that lets a
+    capped upstream op (inner_join_capped, a filter-as-mask) feed this
+    groupby inside ONE jit without compaction.
+
     Returns (Table padded to key_cap rows, valid (key_cap,) bool, overflow
     scalar). Rows past the real group count are garbage and masked by
     `valid`; overflow True means key_cap was too small — retry bigger
     (SplitAndRetry contract)."""
-    return groupby_aggregate(table, key_names, aggs, _cap=key_cap)
+    return groupby_aggregate(table, key_names, aggs, _cap=key_cap,
+                             _alive=alive)

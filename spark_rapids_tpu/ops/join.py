@@ -51,6 +51,7 @@ from ..columnar import Column, Table
 from .sort import _key_operands
 
 __all__ = ["inner_join", "left_join", "left_semi_join", "left_anti_join",
+           "inner_join_capped", "semi_join_mask",
            "join_spans", "expand_spans"]
 
 
@@ -182,7 +183,8 @@ def expand_spans(counts, lo, rorder, *, total: int, outer: bool = False):
     return _expand(counts, lo, rorder, total=total, outer=outer)
 
 
-def _prep(left_keys, right_keys, null_equal: bool, need_rorder: bool = True):
+def _prep(left_keys, right_keys, null_equal: bool, need_rorder: bool = True,
+          lalive=None, ralive=None):
     lcols, rcols = list(left_keys), list(right_keys)
     if len(lcols) != len(rcols) or not lcols:
         raise ValueError("join requires equal, nonzero key column counts")
@@ -206,6 +208,12 @@ def _prep(left_keys, right_keys, null_equal: bool, need_rorder: bool = True):
 
     lvalid = side_valid(lcols, nl)
     rvalid = side_valid(rcols, rcols[0].length)
+    # alive masks exclude rows ENTIRELY (padded rows of a capped upstream
+    # op, filters-as-masks) — unlike null keys they bind even under <=>
+    if lalive is not None:
+        lvalid = lvalid & lalive
+    if ralive is not None:
+        rvalid = rvalid & ralive
     return _join_kernel(tuple(union_ops), lvalid, rvalid,
                         n_ops=len(union_ops), nl=nl, need_rorder=need_rorder)
 
@@ -237,6 +245,46 @@ def left_join(left_keys, right_keys,
     lmap, rmap = _expand(counts, lo, rorder, total=total, outer=True)
     return (Column(dtype=dtypes.INT32, length=total, data=lmap),
             Column(dtype=dtypes.INT32, length=total, data=rmap))
+
+
+def inner_join_capped(left_keys, right_keys, row_cap: int, *,
+                      lalive=None, ralive=None, null_equal: bool = False):
+    """Jit-traceable inner equi-join: a static `row_cap` output instead of
+    the match-count host sync, so whole pipelines (join → join → groupby)
+    fuse into ONE XLA program — the single-chip analogue of
+    parallel.relational's shard-local join tail, sharing its SplitAndRetry
+    contract (overflow True ⇒ retry with a bigger row_cap).
+
+    `lalive`/`ralive` exclude rows entirely (padded rows from a capped
+    upstream op, or dim-table filters applied as masks — the jit tier's
+    filter idiom: a predicate costs one mask AND, not a compaction).
+
+    Returns (lmap, rmap, valid, overflow): (row_cap,) int32 gather maps into
+    the original frames (dead slots hold 0 and are masked by `valid`), a
+    (row_cap,) bool row mask, and a scalar overflow flag."""
+    counts, lo, rorder = _prep(_cols(left_keys), _cols(right_keys),
+                               null_equal, lalive=lalive, ralive=ralive)
+    total = jnp.sum(counts.astype(jnp.int64))   # i32 sum could wrap at 10M×
+    lmap, rmap = _expand(counts, lo, rorder, total=row_cap, outer=False)
+    valid = jnp.arange(row_cap, dtype=jnp.int32) < total
+    nr = _cols(right_keys)[0].length
+    # valid slots carry genuine in-range matches; dead slots are clamped to
+    # row 0 so downstream gathers never need a host sync or a fill value
+    lmap = jnp.where(valid, lmap, 0)
+    rmap = jnp.where(valid, jnp.clip(rmap, 0, max(nr - 1, 0)), 0)
+    return lmap, rmap, valid, total > row_cap
+
+
+def semi_join_mask(left_keys, right_keys, *, lalive=None, ralive=None,
+                   null_equal: bool = False) -> jnp.ndarray:
+    """Jit-traceable semi-join as a MASK: True for (alive) left rows with at
+    least one (alive) right match. The left frame never moves — a semi/anti
+    join inside a jitted pipeline is a mask AND, not a compaction
+    (left_semi_join's nonzero() host sync is the eager-tier form). Anti is
+    the caller's `lalive & ~mask`."""
+    counts, _, _ = _prep(_cols(left_keys), _cols(right_keys), null_equal,
+                         need_rorder=False, lalive=lalive, ralive=ralive)
+    return counts > 0
 
 
 def left_semi_join(left_keys, right_keys,

@@ -112,9 +112,15 @@ def _key_operands(col: Column, ascending: bool, null_precedence: Optional[str]):
 def sorted_order(keys: Union[Table, Sequence[Column], Column],
                  ascending: Union[bool, Sequence[bool]] = True,
                  null_precedence: Union[None, str, Sequence[Optional[str]]] = None,
-                 stable: bool = True) -> Column:
+                 stable: bool = True,
+                 alive: Optional[jnp.ndarray] = None) -> Column:
     """INT32 gather map that sorts `keys` lexicographically
-    (cudf::sorted_order / cudf::stable_sorted_order equivalent)."""
+    (cudf::sorted_order / cudf::stable_sorted_order equivalent).
+
+    `alive`, if given, is a (n,) bool excluding padded rows (the capped
+    jit-pipeline contract): dead rows sink to the END regardless of their
+    key bytes, so live output rows stay a prefix selected by the caller's
+    `iota < live_count` mask."""
     if isinstance(keys, Column):
         cols = [keys]
     elif isinstance(keys, Table):
@@ -135,6 +141,8 @@ def sorted_order(keys: Union[Table, Sequence[Column], Column],
     operands = []
     for c, a, npred in zip(cols, asc, nulls):
         operands.extend(_key_operands(c, a, npred))
+    if alive is not None:
+        operands = [jnp.where(alive, jnp.int32(0), jnp.int32(1))] + operands
     n = cols[0].length
     iota = jnp.arange(n, dtype=jnp.int32)
     out = jax.lax.sort([*operands, iota], num_keys=len(operands),
@@ -155,3 +163,25 @@ def sort_table(table: Table,
     order = sorted_order(keys, ascending, null_precedence, stable)
     # a permutation is never negative: skip take_table's any<0 sync
     return take_table(table, order.data, _has_negative=False)
+
+
+def sort_table_capped(table: Table,
+                      key_names: Optional[Sequence[Union[int, str]]] = None,
+                      ascending: Union[bool, Sequence[bool]] = True,
+                      null_precedence: Union[None, str,
+                                             Sequence[Optional[str]]] = None,
+                      stable: bool = True,
+                      alive: Optional[jnp.ndarray] = None):
+    """sort_table for the capped jit tier (the *_capped sibling of
+    groupby_aggregate_capped / inner_join_capped): dead rows sink to the
+    END regardless of key bytes. Returns (sorted Table, sorted alive mask)
+    — live rows are a prefix."""
+    if key_names is None:
+        keys = list(table.columns)
+    else:
+        keys = [table[k] for k in key_names]
+    order = sorted_order(keys, ascending, null_precedence, stable, alive)
+    out = take_table(table, order.data, _has_negative=False)
+    if alive is None:
+        alive = jnp.ones((table.num_rows,), bool)
+    return out, jnp.take(alive, order.data, axis=0)

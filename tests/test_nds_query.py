@@ -8,7 +8,7 @@ import pandas as pd
 
 import spark_rapids_tpu  # noqa: F401
 
-from benchmarks.bench_nds_q3 import _datagen, build_tables, q3
+from benchmarks.bench_nds_q3 import _datagen, build_tables, q3, q3_capped
 
 
 def test_nds_q3_pipeline_matches_pandas():
@@ -45,10 +45,22 @@ def test_nds_q3_pipeline_matches_pandas():
     assert (sorted(zip(got.d_year, got.i_brand, got.revenue)) ==
             sorted(zip(ref.d_year, ref.i_brand, ref.revenue)))
 
+    # the jitted capped tier (what the bench measures) agrees with the
+    # eager plan row for row
+    import jax
+    capped, valid, overflow = jax.jit(q3_capped)(sales, dates, items)
+    assert not bool(overflow)
+    m = np.asarray(valid)
+    assert m.sum() == len(ref)
+    for name in ("d_year", "i_brand", "revenue"):
+        np.testing.assert_array_equal(
+            np.asarray(capped[name].data)[m],
+            np.asarray(out[name].data), err_msg=name)
+
 
 def test_nds_q5_pipeline_matches_pandas():
     from benchmarks.bench_nds_q5 import (DATE_HI, DATE_LO, _datagen,
-                                         build_tables, q5)
+                                         build_tables, q5, q5_capped)
     n_sales = 30_000
     tabs, dates = build_tables(n_sales, seed=3)
     out = q5(tabs, dates)
@@ -81,6 +93,16 @@ def test_nds_q5_pipeline_matches_pandas():
     assert len(got) == len(ref) == 4
     for c in ("channel", "sales", "returns", "profit", "loss"):
         np.testing.assert_array_equal(got[c].values, ref[c].values, err_msg=c)
+
+    # the jitted capped tier agrees with the eager plan row for row
+    import jax
+    capped, valid, overflow = jax.jit(q5_capped)(tabs, dates)
+    assert not bool(overflow)
+    m = np.asarray(valid)
+    assert m.sum() == 4
+    for c in ("channel", "sales", "returns", "profit", "loss"):
+        np.testing.assert_array_equal(np.asarray(capped[c].data)[m],
+                                      got[c].values, err_msg=c)
 
 
 def test_nds_q23_pipeline_matches_pandas():
@@ -117,6 +139,19 @@ def test_nds_q23_pipeline_matches_pandas():
     assert int(detail["total"]) == total
     assert total > 0                      # the HAVING clauses selected rows
 
+    # the jitted capped tier: same subquery sets, same per-side totals
+    import jax
+    from benchmarks.bench_nds_q23 import q23_capped
+    capped = jax.jit(q23_capped)(store, sides)
+    assert not bool(capped["overflow"])
+    fa = np.asarray(capped["freq_alive"])
+    ba = np.asarray(capped["best_alive"])
+    assert set(np.asarray(capped["freq_keys"])[fa].tolist()) == freq_items
+    assert set(np.asarray(capped["best_keys"])[ba].tolist()) == best
+    for per_side, want in zip(capped["per_side"], detail["per_side"]):
+        assert int(per_side) == int(want)
+    assert int(capped["total"]) == total
+
 
 def test_nds_q72_pipeline_matches_pandas():
     from benchmarks.bench_nds_q72 import _datagen, build_tables, q72
@@ -149,3 +184,15 @@ def test_nds_q72_pipeline_matches_pandas():
     assert len(got) > 0
     for c in ("i_item_sk", "w_warehouse_sk", "d_week", "cnt"):
         np.testing.assert_array_equal(got[c].values, ref[c].values, err_msg=c)
+
+    # the jitted capped tier agrees with the eager plan row for row
+    import jax
+    from benchmarks.bench_nds_q72 import q72_capped
+    capped, valid, overflow = jax.jit(q72_capped)(*build_tables(n_sales,
+                                                                seed=5))
+    assert not bool(overflow)
+    m = np.asarray(valid)
+    assert m.sum() == len(ref)
+    for c in ("i_item_sk", "w_warehouse_sk", "d_week", "cnt"):
+        np.testing.assert_array_equal(np.asarray(capped[c].data)[m],
+                                      got[c].values, err_msg=c)

@@ -394,3 +394,126 @@ def test_groupby_capped_small_batch_and_overflow_retry():
                                                  key_cap=4)
     assert not bool(ov0) and not np.asarray(valid0).any()
     assert out0.columns[0].length == 4
+
+
+def test_inner_join_capped_matches_eager_under_jit():
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops import inner_join_capped
+    rng = np.random.default_rng(23)
+    nl, nr = 4000, 600
+    lk = col(rng.integers(0, 500, nl).astype(np.int64))
+    rk = col(rng.integers(0, 500, nr).astype(np.int64))
+    ref_l, ref_r = inner_join([lk], [rk])
+    ref = sorted(zip(np.asarray(ref_l.data).tolist(),
+                     np.asarray(ref_r.data).tolist()))
+
+    @jax.jit
+    def run(l, r):
+        return inner_join_capped([l], [r], row_cap=nl * 4)
+
+    lmap, rmap, valid, overflow = run(lk, rk)
+    assert not bool(overflow)
+    v = np.asarray(valid)
+    got = sorted(zip(np.asarray(lmap)[v].tolist(),
+                     np.asarray(rmap)[v].tolist()))
+    assert got == ref
+    # alive masks exclude rows from matching entirely
+    lalive = jnp.asarray(np.asarray(lk.data) % 2 == 0)
+    ralive = jnp.asarray(np.asarray(rk.data) % 3 == 0)
+    lmap2, rmap2, valid2, ovf2 = inner_join_capped(
+        [lk], [rk], row_cap=nl * 4, lalive=lalive, ralive=ralive)
+    v2 = np.asarray(valid2)
+    la, ra = np.asarray(lalive), np.asarray(ralive)
+    ref2 = sorted((l, r) for l, r in ref if la[l] and ra[r])
+    got2 = sorted(zip(np.asarray(lmap2)[v2].tolist(),
+                      np.asarray(rmap2)[v2].tolist()))
+    assert got2 == ref2
+    # too-small cap flags overflow (SplitAndRetry contract)
+    *_, ovf3 = inner_join_capped([lk], [rk], row_cap=16)
+    assert bool(ovf3)
+
+
+def test_semi_join_mask_matches_eager():
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops import semi_join_mask
+    rng = np.random.default_rng(29)
+    nl, nr = 3000, 400
+    lk = col(rng.integers(0, 900, nl).astype(np.int64))
+    rk = col(rng.integers(0, 900, nr).astype(np.int64))
+    keep = left_semi_join([lk], [rk])
+    want = np.zeros(nl, bool)
+    want[np.asarray(keep.data)] = True
+    mask = jax.jit(lambda l, r: semi_join_mask([l], [r]))(lk, rk)
+    np.testing.assert_array_equal(np.asarray(mask), want)
+    # ralive: dead right rows can't witness a match
+    ralive = jnp.asarray(np.asarray(rk.data) % 2 == 0)
+    mask2 = semi_join_mask([lk], [rk], ralive=ralive)
+    rset = set(np.asarray(rk.data)[np.asarray(ralive)].tolist())
+    want2 = np.asarray([int(k) in rset for k in np.asarray(lk.data)])
+    np.testing.assert_array_equal(np.asarray(mask2), want2)
+
+
+def test_groupby_capped_alive_excludes_dead_rows():
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops import groupby_aggregate_capped
+    rng = np.random.default_rng(31)
+    n = 5000
+    k = rng.integers(0, 40, n).astype(np.int32)
+    v = rng.integers(-100, 100, n).astype(np.int64)
+    alive = rng.random(n) < 0.7
+    t = Table([col(k), col(v)], names=["k", "v"])
+    # oracle: groupby over only the alive rows
+    ref = (pd.DataFrame({"k": k[alive], "v": v[alive]})
+           .groupby("k", as_index=False)
+           .agg(s=("v", "sum"), c=("v", "count"), mn=("v", "min"))
+           .sort_values("k"))
+
+    @jax.jit
+    def run(tb, a):
+        out, valid, overflow = groupby_aggregate_capped(
+            tb, ["k"], [("v", "sum"), ("v", "count"), ("v", "min")],
+            key_cap=64, alive=a)
+        return [c.data for c in out.columns], valid, overflow
+
+    cols, valid, overflow = run(t, jnp.asarray(alive))
+    assert not bool(overflow)
+    m = np.asarray(valid)
+    assert m.sum() == len(ref)
+    np.testing.assert_array_equal(np.asarray(cols[0])[m], ref.k.values)
+    np.testing.assert_array_equal(np.asarray(cols[1])[m], ref.s.values)
+    np.testing.assert_array_equal(np.asarray(cols[2])[m], ref.c.values)
+    np.testing.assert_array_equal(np.asarray(cols[3])[m], ref.mn.values)
+    # a group whose rows are ALL dead must not appear: kill one key entirely
+    alive2 = alive & (k != int(k[0]))
+    cols2, valid2, _ = run(t, jnp.asarray(alive2))
+    m2 = np.asarray(valid2)
+    assert int(k[0]) not in np.asarray(cols2[0])[m2].tolist()
+
+
+def test_sort_table_alive_sinks_dead_rows():
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops import sort_table_capped
+    rng = np.random.default_rng(37)
+    n = 1000
+    k = rng.integers(0, 50, n).astype(np.int64)
+    p = rng.integers(0, 10**6, n).astype(np.int64)
+    alive = rng.random(n) < 0.5
+    t = Table([col(k), col(p)], names=["k", "p"])
+
+    @jax.jit
+    def run(tb, a):
+        out, sa = sort_table_capped(tb, key_names=["k"], ascending=[False],
+                                    alive=a)
+        return [c.data for c in out.columns], sa
+
+    cols, sa = run(t, jnp.asarray(alive))
+    sa = np.asarray(sa)
+    live = int(alive.sum())
+    # live rows form a prefix, sorted desc; dead rows all sink behind
+    assert sa[:live].all() and not sa[live:].any()
+    got_k = np.asarray(cols[0])[:live]
+    np.testing.assert_array_equal(got_k, np.sort(k[alive])[::-1])
