@@ -11,6 +11,8 @@ python benchmarks/run_all.py --scale 0.01 --iters 5 --cpu
 ./ci/sanitizer.sh
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('multichip OK')"
+# Multi-PROCESS mesh proof (jax.distributed, 2 procs x 4 CPU devices) runs
+# in the pytest tier above: tests/test_multiproc_mesh.py.
 # Real-TPU oracle smoke: exit 75 (tunnel unreachable) is tolerated — the tier
 # runs whenever the axon tunnel is up, and a dead tunnel is infrastructure,
 # not a nightly failure.

@@ -57,9 +57,17 @@ def _groupby_kernel(key_operands, agg_datas, agg_valids, *, n_ops: int,
                     has_alive: bool = False):
     """Scatter-free, gather-free sorted aggregation (round-4 redesign).
 
-    On-chip primitive costs (tools/primitives sweep + docs/architecture.md,
+    On-chip primitive costs (round-2 TPU measurement, recorded in
+    docs/architecture.md:39-42; the reproducible sweep tool is
+    tools/tpu_primitives.py, whose committed CPU capture is
+    tools/primitives.jsonl — TPU rerun queued for the next tunnel window;
     10M rows): sort ≈ 38 ms with cheap marginal payload operands, cumsum ≈
     16 ms, but a RANDOM GATHER ≈ 160 ms and a random scatter ≈ 930 ms. The
+    tradeoff is BACKEND-SPECIFIC: on CPU a random scatter-add costs ~163 ms
+    against ~233 ms per tuple-carry scan (primitives.jsonl), so this design
+    measures ~0.49× the old scatter-based kernel there (tools/
+    ab_relational.jsonl) — the win this layout buys exists on TPU, where
+    scatters are ~25× a cumsum. The
     previous kernel did one value gather per aggregation plus 4 positional
     gathers per cumsum-difference — gathers dominated (~0.9 s at 10M). This
     version has zero data-sized gathers:

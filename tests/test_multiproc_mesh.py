@@ -1,0 +1,23 @@
+"""Multi-process mesh proof as a test: tools/multiproc_mesh.py spawns two
+jax.distributed processes (4 CPU devices each) and runs the distributed
+relational tier over the GLOBAL 8-device mesh — the multi-host north-star
+path (SURVEY.md §2.4). Subprocess-orchestrated because jax.distributed can
+initialize only once per process; the workers must not inherit this test
+process's single-process JAX env."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_mesh_runs_distributed_tier():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "multiproc_mesh.py")],
+        env=env, capture_output=True, text=True, timeout=580)
+    ok_lines = [ln for ln in r.stdout.splitlines()
+                if ln.startswith("MULTIPROC MESH OK")]
+    assert r.returncode == 0, (r.stdout[-800:], r.stderr[-800:])
+    assert len(ok_lines) == 2, r.stdout[-800:]
