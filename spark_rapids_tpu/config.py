@@ -12,6 +12,7 @@ equivalents, all read at use time (not import time) so tests can monkeypatch:
 | SPARK_RAPIDS_TPU_RETRY_LIMIT     | 500  | livelock cap before hard OOM   |
 | SPARK_RAPIDS_TPU_TRACE           | 0    | profiler ranges (utils/tracing)|
 | TPU_FAULT_INJECTOR_CONFIG_PATH   | —    | fault injector config (faultinj)|
+| SPARK_RAPIDS_TPU_ROW_CONVERSION_KERNEL | auto | auto/word/concat (ops/row_conversion) |
 """
 from __future__ import annotations
 
@@ -39,3 +40,11 @@ def retry_limit() -> int:
 
 def trace_enabled() -> bool:
     return os.environ.get("SPARK_RAPIDS_TPU_TRACE", "") == "1"
+
+
+def row_conversion_kernel() -> str:
+    """Row-conversion kernel selection: auto (default: u32 word kernel on
+    TPU, byte-concat kernel on CPU — see ops/row_conversion.py), or force
+    "word" / "concat"."""
+    v = os.environ.get("SPARK_RAPIDS_TPU_ROW_CONVERSION_KERNEL", "auto")
+    return v if v in ("auto", "word", "concat") else "auto"

@@ -77,8 +77,120 @@ def row_layout(dts: Sequence[dtypes.DType]):
     return offsets, validity_offset, row_size
 
 
+def _use_word_kernel() -> bool:
+    """Backend dispatch for the conversion kernels. The u32 word kernels
+    exist for TPU tiling (narrow u8 slices pad to (32, 128) tiles; measured
+    CPU A/B in BENCH_DETAIL.md round-5: the word kernel is ~1.4x SLOWER on
+    CPU where the concat lowers to clean memcpys, so CPU keeps the byte
+    kernels). Override: SPARK_RAPIDS_TPU_ROW_CONVERSION_KERNEL=word|concat."""
+    from ..config import row_conversion_kernel
+    mode = row_conversion_kernel()
+    if mode == "word":
+        return True
+    if mode == "concat":
+        return False
+    return jax.default_backend() != "cpu"
+
+
+def _word_plan(dts: Sequence[dtypes.DType]):
+    """Static u32-word assembly plan for the row image.
+
+    The JCUDF alignment rule (min(width, 8)) means every >=4-byte column
+    starts 4-aligned and every 2-byte column never straddles a u32 word, so
+    each output u32 word is either exactly one WORD of one column ("w") or
+    a static pack of four byte sources ("b": column byte / validity byte /
+    zero). Assembling at word granularity is the roofline move on TPU: a
+    216-column row becomes ~180 full-lane u32 ops + ONE (words, n) ->
+    (n, words) transpose, instead of 216 narrow (n, 1..8) u8 concatenate
+    parts whose (32, 128) tile padding wastes ~97% of each copy.
+    """
+    col_offsets, validity_offset, row_size = row_layout(dts)
+    byte_src = [("z",)] * row_size
+    for i, (dt, off) in enumerate(zip(dts, col_offsets)):
+        for k in range(dt.itemsize()):
+            byte_src[off + k] = ("c", i, k)
+    for b in range((len(dts) + 7) // 8):
+        byte_src[validity_offset + b] = ("v", b)
+    words = []
+    for wpos in range(row_size // 4):
+        srcs = byte_src[wpos * 4:(wpos + 1) * 4]
+        s0 = srcs[0]
+        if (s0[0] == "c" and s0[2] % 4 == 0 and
+                all(s[0] == "c" and s[1] == s0[1] and s[2] == s0[2] + j
+                    for j, s in enumerate(srcs))):
+            words.append(("w", s0[1], s0[2] // 4))
+        else:
+            words.append(("b", tuple(srcs)))
+    return tuple(words), validity_offset, row_size
+
+
+def _column_words(col: Column):
+    """(n, w//4) uint32 LE word image of a >=4-byte column's data."""
+    data = col.data
+    kind = col.dtype.kind
+    if kind == dtypes.Kind.DECIMAL128:
+        return data                     # already (n, 4) LE u32 limbs
+    if kind == dtypes.Kind.FLOAT64 and jax.default_backend() != "cpu":
+        # the TPU X64 pass has no bitcast *from* f64 — take the view host-side
+        return jnp.asarray(np.asarray(data).view("<u4").reshape(-1, 2))
+    out = jax.lax.bitcast_convert_type(data, jnp.uint32)
+    return out.reshape(-1, 1) if out.ndim == 1 else out
+
+
+def _column_small_bytes(col: Column) -> jnp.ndarray:
+    """(n, w) uint8 byte image of a 1/2-byte column's data."""
+    if col.dtype.kind == dtypes.Kind.BOOL:
+        return col.data.astype(jnp.uint8)[:, None]
+    if col.dtype.itemsize() == 1:
+        return jax.lax.bitcast_convert_type(
+            col.data, jnp.uint8).reshape(-1, 1)
+    return jax.lax.bitcast_convert_type(col.data, jnp.uint8)
+
+
+@partial(jax.jit, static_argnames=("plan", "n_cols"))
+def _to_rows_kernel(wides, smalls, masks, *, plan, n_cols: int):
+    words_plan, validity_offset, row_size = plan
+    n = (wides + smalls)[0].shape[0] if (wides or smalls) else 0
+    # validity bytes as u32: bit i%8 of byte i//8 set when column i is valid
+    vbytes = []
+    for b in range((n_cols + 7) // 8):
+        byte = jnp.zeros((n,), jnp.uint32)
+        for bit in range(min(8, n_cols - b * 8)):
+            byte = byte | (masks[b * 8 + bit].astype(jnp.uint32) << bit)
+        vbytes.append(byte)
+
+    def byte_val(src):
+        tag = src[0]
+        if tag == "z":
+            return None
+        if tag == "v":
+            return vbytes[src[1]]
+        # "c" sources in byte-packed words are always SMALL columns: a
+        # >=4-byte column is 4-aligned with width a multiple of 4, so all
+        # its words classify as "w" in _word_plan
+        _, i, k = src
+        return smalls[i][:, k].astype(jnp.uint32)
+
+    cols32 = []
+    for w in words_plan:
+        if w[0] == "w":
+            cols32.append(wides[w[1]][:, w[2]])
+        else:
+            acc = jnp.zeros((n,), jnp.uint32)
+            for j, src in enumerate(w[1]):
+                v = byte_val(src)
+                if v is not None:
+                    acc = acc | (v << (8 * j))
+            cols32.append(acc)
+    stacked = jnp.stack(cols32, axis=0)            # (row_words, n) u32
+    rows32 = stacked.T                             # ONE transpose
+    return jax.lax.bitcast_convert_type(rows32, jnp.uint8).reshape(
+        n, row_size)
+
+
 def _column_bytes(col: Column) -> jnp.ndarray:
-    """(n, w) little-endian byte image of a fixed-width column's data."""
+    """(n, w) little-endian byte image of a fixed-width column's data
+    (concat-kernel path)."""
     w = col.dtype.itemsize()
     data = col.data
     if col.dtype.kind == dtypes.Kind.BOOL:
@@ -95,7 +207,10 @@ def _column_bytes(col: Column) -> jnp.ndarray:
 
 
 @partial(jax.jit, static_argnames=("layout",))
-def _to_rows_kernel(datas, masks, *, layout):
+def _to_rows_concat_kernel(datas, masks, *, layout):
+    """Byte-concatenate assembly: one (n, w) u8 part per column. Lowers to
+    clean memcpys on CPU; on TPU each narrow u8 part pads to (32, 128)
+    tiles, which is why the word kernel exists."""
     col_offsets, validity_offset, row_size = layout
     n = datas[0].shape[0] if datas else 0
     parts = []
@@ -123,12 +238,26 @@ def _to_rows_kernel(datas, masks, *, layout):
 def convert_to_rows(table: Table) -> List[Column]:
     """Table -> row-major LIST<UINT8> column (RowConversion.convertToRows)."""
     cols = list(table.columns)
-    col_offsets, validity_offset, row_size = row_layout([c.dtype for c in cols])
+    dts = [c.dtype for c in cols]
     n = table.num_rows
-    datas = tuple(_column_bytes(c) for c in cols)
     masks = tuple(c.null_mask for c in cols)
-    rows = _to_rows_kernel(datas, masks,
-                           layout=(tuple(col_offsets), validity_offset, row_size))
+    if _use_word_kernel():
+        plan = _word_plan(dts)
+        empty = jnp.zeros((n, 0), jnp.uint32)
+        empty8 = jnp.zeros((n, 0), jnp.uint8)
+        wides = tuple(_column_words(c) if c.dtype.itemsize() >= 4 else empty
+                      for c in cols)
+        smalls = tuple(_column_small_bytes(c) if c.dtype.itemsize() < 4
+                       else empty8 for c in cols)
+        rows = _to_rows_kernel(wides, smalls, masks, plan=plan,
+                               n_cols=len(cols))
+        row_size = plan[2]
+    else:
+        col_offsets, validity_offset, row_size = row_layout(dts)
+        datas = tuple(_column_bytes(c) for c in cols)
+        rows = _to_rows_concat_kernel(
+            datas, masks,
+            layout=(tuple(col_offsets), validity_offset, row_size))
     offsets = (jnp.arange(n + 1, dtype=jnp.int32) * row_size)
     return [Column.make_list(offsets, Column(dtype=dtypes.UINT8,
                                              length=n * row_size,
@@ -162,7 +291,9 @@ def convert_from_rows_fixed_width_optimized(
 
 
 @partial(jax.jit, static_argnames=("layout", "kinds"))
-def _from_rows_kernel(rows, *, layout, kinds):
+def _from_rows_slice_kernel(rows, *, layout, kinds):
+    """Byte-slice decode (concat-kernel sibling): one narrow u8 slice +
+    bitcast per column. CPU path; see _use_word_kernel."""
     col_offsets, validity_offset, row_size = layout
     datas = []
     masks = []
@@ -186,8 +317,54 @@ def _from_rows_kernel(rows, *, layout, kinds):
             u32 = jax.lax.optimization_barrier(u32)
             datas.append(jax.lax.bitcast_convert_type(u32, jnp.float64))
         else:
-            datas.append(jax.lax.bitcast_convert_type(block, dt.storage_dtype()))
+            datas.append(jax.lax.bitcast_convert_type(block,
+                                                      dt.storage_dtype()))
         vbyte = rows[:, validity_offset + i // 8]
+        masks.append((vbyte >> (i % 8)) & 1 != 0)
+    return datas, masks
+
+
+@partial(jax.jit, static_argnames=("layout", "kinds"))
+def _from_rows_kernel(rows, *, layout, kinds):
+    """Word-wise decode: ONE u8->u32 bitcast of the whole row image, then
+    every column is full-lane u32 slices + shifts/bitcasts (no narrow u8
+    slicing — the same tiling argument as _to_rows_kernel)."""
+    col_offsets, validity_offset, row_size = layout
+    n = rows.shape[0]
+    W = jax.lax.bitcast_convert_type(
+        rows.reshape(n, row_size // 4, 4), jnp.uint32)   # (n, row_words)
+    datas = []
+    masks = []
+    for i, (off, kind) in enumerate(zip(col_offsets, kinds)):
+        dt = dtypes.DType(kind)
+        w = dt.itemsize()
+        wpos, sh = off // 4, 8 * (off % 4)
+        if w >= 4:
+            block = jax.lax.slice_in_dim(W, wpos, wpos + w // 4, axis=1)
+        if kind == dtypes.Kind.BOOL:
+            datas.append((W[:, wpos] >> sh) & 0xFF != 0)
+        elif kind == dtypes.Kind.DECIMAL128:
+            datas.append(block)                          # (n, 4) LE limbs
+        elif w == 1:
+            b = ((W[:, wpos] >> sh) & 0xFF).astype(jnp.uint8)
+            datas.append(jax.lax.bitcast_convert_type(b, dt.storage_dtype()))
+        elif w == 2:                    # 2-aligned: never straddles a word
+            h = ((W[:, wpos] >> sh) & 0xFFFF).astype(jnp.uint16)
+            datas.append(jax.lax.bitcast_convert_type(h, dt.storage_dtype()))
+        elif kind == dtypes.Kind.FLOAT64:
+            # u32[2] -> f64: the TPU X64 pass implements bitcasts *to* f64
+            # only from 32-bit sources; the barrier stops XLA from fusing
+            # into a (malformed) direct bitcast.
+            u32 = jax.lax.optimization_barrier(block)
+            datas.append(jax.lax.bitcast_convert_type(u32, jnp.float64))
+        elif w == 4:
+            datas.append(jax.lax.bitcast_convert_type(block[:, 0],
+                                                      dt.storage_dtype()))
+        else:                           # 8-byte ints/timestamps
+            datas.append(jax.lax.bitcast_convert_type(block,
+                                                      dt.storage_dtype()))
+        vpos = validity_offset + i // 8
+        vbyte = (W[:, vpos // 4] >> (8 * (vpos % 4))) & 0xFF
         masks.append((vbyte >> (i % 8)) & 1 != 0)
     return datas, masks
 
@@ -213,7 +390,9 @@ def convert_from_rows(rows_col: Column, schema: Sequence[dtypes.DType]) -> Table
                 f"rows column must be contiguous with a uniform {row_size}-byte "
                 "stride matching the schema's row layout")
     rows = rows_col.children[0].data[: n * row_size].reshape(n, row_size)
-    datas, masks = _from_rows_kernel(
+    kernel = _from_rows_kernel if _use_word_kernel() else \
+        _from_rows_slice_kernel
+    datas, masks = kernel(
         rows, layout=(tuple(col_offsets), validity_offset, row_size),
         kinds=tuple(dt.kind for dt in schema))
     cols = []
