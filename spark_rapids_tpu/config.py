@@ -45,6 +45,11 @@ def trace_enabled() -> bool:
 def row_conversion_kernel() -> str:
     """Row-conversion kernel selection: auto (default: u32 word kernel on
     TPU, byte-concat kernel on CPU — see ops/row_conversion.py), or force
-    "word" / "concat"."""
+    "word" / "concat". A typo must not silently fall back to auto — an A/B
+    capture would attribute numbers to the wrong kernel."""
     v = os.environ.get("SPARK_RAPIDS_TPU_ROW_CONVERSION_KERNEL", "auto")
-    return v if v in ("auto", "word", "concat") else "auto"
+    if v not in ("auto", "word", "concat"):
+        raise ValueError(
+            f"SPARK_RAPIDS_TPU_ROW_CONVERSION_KERNEL={v!r}: expected "
+            "auto, word, or concat")
+    return v

@@ -144,6 +144,9 @@ def _join_kernel(operands, lvalid, rvalid, *, n_ops: int, nl: int,
 @partial(jax.jit, static_argnames=("total", "outer"))
 def _expand(counts, lo, rorder, *, total: int, outer: bool):
     nl = counts.shape[0]
+    if nl == 0:     # static: empty left side expands to all-dead slots
+        return (jnp.zeros((total,), jnp.int32),
+                jnp.full((total,), -1, jnp.int32))
     eff = jnp.maximum(counts, 1) if outer else counts
     starts = jnp.cumsum(eff) - eff            # exclusive scan
     # which left row produced output slot j: repeat row ids by their counts

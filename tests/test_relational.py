@@ -517,3 +517,28 @@ def test_sort_table_alive_sinks_dead_rows():
     assert sa[:live].all() and not sa[live:].any()
     got_k = np.asarray(cols[0])[:live]
     np.testing.assert_array_equal(got_k, np.sort(k[alive])[::-1])
+
+
+def test_inner_join_capped_edges_and_string_keys():
+    import jax
+    from spark_rapids_tpu.ops import inner_join_capped, semi_join_mask
+    # empty right side: no matches, no overflow, static shapes hold
+    lk = col(np.array([1, 2, 3], np.int64))
+    empty = col(np.zeros(0, np.int64))
+    _, _, v, o = inner_join_capped([lk], [empty], row_cap=8)
+    assert not np.asarray(v).any() and not bool(o)
+    # empty LEFT side under a nonzero cap (regression: _expand used to
+    # broadcast (cap,) against (0,))
+    _, _, v, o = jax.jit(
+        lambda l, r: inner_join_capped([l], [r], row_cap=8))(empty, lk)
+    assert not np.asarray(v).any() and not bool(o)
+    # string keys ride the same machinery; nulls never match
+    ls = scol(["a", "bb", "a", None, "ccc"])
+    rs = scol(["a", "ccc", "zz"])
+    lm, rm, v, o = inner_join_capped([ls], [rs], row_cap=16)
+    m = np.asarray(v)
+    assert sorted(zip(np.asarray(lm)[m].tolist(),
+                      np.asarray(rm)[m].tolist())) == \
+        [(0, 0), (2, 0), (4, 1)]
+    assert np.asarray(semi_join_mask([ls], [rs])).tolist() == \
+        [True, False, True, False, True]
