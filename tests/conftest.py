@@ -58,6 +58,9 @@ TPU_SMOKE_PREFIXES = (
     "tests/test_relational.py::test_groupby_sum_count_basic",
     "tests/test_relational.py::test_inner_join_basic_with_dups",
     "tests/test_relational.py::test_sort_float_nan_and_negzero",
+    "tests/test_relational.py::test_inner_join_capped_matches_eager_under_jit",
+    "tests/test_relational.py::test_groupby_capped_alive_excludes_dead_rows",
+    "tests/test_row_conversion.py::test_word_and_concat_kernels_agree",
     "tests/test_copying.py::test_concat_fixed_and_strings",
 )
 

@@ -196,3 +196,24 @@ def test_nds_q72_pipeline_matches_pandas():
     for c in ("i_item_sk", "w_warehouse_sk", "d_week", "cnt"):
         np.testing.assert_array_equal(np.asarray(capped[c].data)[m],
                                       got[c].values, err_msg=c)
+
+
+def test_nds_q3_capped_autoretry_grows_cap():
+    """The single-chip capped tier shares the distributed tier's
+    SplitAndRetry contract: a too-small key_cap flags overflow instead of
+    corrupting, and parallel.autoretry's generic driver loop grows it
+    until the pipeline fits."""
+    from spark_rapids_tpu.parallel.autoretry import auto_retry_overflow
+    n_sales = 20_000
+    sales, dates, items = build_tables(n_sales, seed=7)
+    *_, ovf_small = q3_capped(sales, dates, items, key_cap=4)
+    assert bool(ovf_small)                # tiny cap must flag, not corrupt
+    (out, valid, overflow), caps = auto_retry_overflow(
+        lambda key_cap: q3_capped(sales, dates, items, key_cap=key_cap),
+        {"key_cap": 4})
+    assert not bool(overflow) and caps["key_cap"] > 4
+    eager = q3(sales, dates, items)
+    m = np.asarray(valid)
+    assert m.sum() == eager.num_rows
+    np.testing.assert_array_equal(np.asarray(out["revenue"].data)[m],
+                                  np.asarray(eager["revenue"].data))

@@ -21,7 +21,15 @@ print(f"tunnel: {st}")
 sys.exit(0 if st != "dead" else 75)
 EOF
 rc=$?
-[ $rc -eq 0 ] || { echo "tunnel dead - nothing to capture (exit 75)"; exit 75; }
+if [ $rc -eq 75 ]; then
+    echo "tunnel dead - nothing to capture (exit 75)"
+    exit 75
+elif [ $rc -ne 0 ]; then
+    # probe itself broke (import error, env) - NOT the retryable no-window
+    # condition; surface it so automation doesn't retry forever
+    echo "tunnel probe FAILED rc=$rc (not a dead tunnel)" >&2
+    exit $rc
+fi
 
 set -x
 fail=0
@@ -45,13 +53,14 @@ python benchmarks/bench_parse_uri.py --scale 0.0005 --iters 3 \
 python benchmarks/bench_parse_uri.py --scale 0.005 --iters 3 \
     | tee -a tools/tpu_parse_uri.jsonl || fail=1
 
-# 6. row-conversion word-kernel A/B on device
+# 6. row-conversion word-kernel A/B on device — one file per kernel so the
+#    records stay attributable (run_config emits no kernel field)
 SPARK_RAPIDS_TPU_ROW_CONVERSION_KERNEL=word \
     python benchmarks/bench_row_conversion.py --scale 0.2 --iters 5 \
-    | tee -a tools/tpu_row_conversion.jsonl || fail=1
+    | tee -a tools/tpu_row_conversion_word.jsonl || fail=1
 SPARK_RAPIDS_TPU_ROW_CONVERSION_KERNEL=concat \
     python benchmarks/bench_row_conversion.py --scale 0.2 --iters 5 \
-    | tee -a tools/tpu_row_conversion.jsonl || fail=1
+    | tee -a tools/tpu_row_conversion_concat.jsonl || fail=1
 
 # 7. headline
 python bench.py || fail=1
