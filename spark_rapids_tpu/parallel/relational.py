@@ -468,9 +468,11 @@ def _check_word_counts(l_words, r_words):
 
 
 def _distributed_join_keyed(mesh, l_words, lvals, r_words, rvals, key_specs,
-                            row_cap, slack, axis, outer):
-    """Shared typed-key equi-join body (inner / left-outer): exchange both
-    sides by the Spark-exact hash of the words, join shard-locally. NULL
+                            row_cap, slack, axis, outer, broadcast=False):
+    """Shared typed-key equi-join body (inner / left-outer / broadcast):
+    move the build side — hash-exchange BOTH sides by the Spark-exact hash
+    of the words, or (`broadcast`) all_gather the small right side onto
+    every shard while the left never moves — then join shard-locally. NULL
     keys never match (keys.keys_null_mask feeds the match masks), matching
     Spark's `l.k = r.k` semantics — under `outer` a null-keyed left row is
     emitted null-extended."""
@@ -487,10 +489,19 @@ def _distributed_join_keyed(mesh, l_words, lvals, r_words, rvals, key_specs,
         lv = list(arrs[nw:nw + nlv])
         rw = list(arrs[nw + nlv:nw + nlv + nw])
         rv = list(arrs[nw + nlv + nw:])
-        Lw, Lv, Lalive, lspill = _hash_exchange(
-            axis, n_peers, slack, lw, lv, hash_fn)
-        Rw, Rv, Ralive, rspill = _hash_exchange(
-            axis, n_peers, slack, rw, rv, hash_fn)
+        if broadcast:
+            # build side replicated over ICI; probe side stays in place
+            Lw, Lv = lw, lv
+            Rw = [jax.lax.all_gather(w, axis, tiled=True) for w in rw]
+            Rv = [jax.lax.all_gather(v, axis, tiled=True) for v in rv]
+            Lalive = jnp.ones((Lw[0].shape[0],), jnp.bool_)
+            Ralive = jnp.ones((Rw[0].shape[0],), jnp.bool_)
+            lspill = rspill = jnp.zeros((), jnp.bool_)
+        else:
+            Lw, Lv, Lalive, lspill = _hash_exchange(
+                axis, n_peers, slack, lw, lv, hash_fn)
+            Rw, Rv, Ralive, rspill = _hash_exchange(
+                axis, n_peers, slack, rw, rv, hash_fn)
         lmatch = Lalive & ~keys_null_mask(Lw, key_specs)
         rmatch = Ralive & ~keys_null_mask(Rw, key_specs)
         out_lw, out_lv, out_rv, rvalid, live, joverflow = _local_join_tail(
@@ -558,6 +569,25 @@ def distributed_broadcast_join(mesh: Mesh, lkeys: jnp.ndarray,
     fn = shard_map(local, mesh=mesh, in_specs=(spec,) * 4,
                    out_specs=(spec,) * 5)
     return fn(lkeys, lvals, rkeys, rvals)
+
+
+def distributed_broadcast_join_keyed(mesh: Mesh,
+                                     l_words: Sequence[jnp.ndarray],
+                                     lvals: Sequence[jnp.ndarray],
+                                     r_words: Sequence[jnp.ndarray],
+                                     rvals: Sequence[jnp.ndarray],
+                                     key_specs, row_cap: int,
+                                     axis: str = "data"):
+    """Typed-key broadcast inner join: the word-encoded (small) build side
+    is replicated onto every shard with `all_gather` over ICI and each left
+    shard joins locally — the typed sibling of distributed_broadcast_join,
+    completing the broadcast path for string/decimal128/float/nullable keys
+    (the reference's BroadcastHashJoin handles any key type). NULL keys
+    never match (keys.keys_null_mask). Returns per-shard padded
+    ([l key words], [lvals], [rvals], valid, overflow)."""
+    return _distributed_join_keyed(mesh, l_words, lvals, r_words, rvals,
+                                   key_specs, row_cap, slack=1.0, axis=axis,
+                                   outer=False, broadcast=True)
 
 
 def distributed_left_join_keyed(mesh: Mesh, l_words: Sequence[jnp.ndarray],

@@ -318,3 +318,46 @@ def test_distributed_groupby_multi_count_only():
     import pytest as _pytest
     with _pytest.raises(ValueError):
         distributed_groupby_multi(mesh, [k], [], [(0, "sum")], 16)
+
+
+def test_broadcast_join_keyed_string_decimal():
+    """Typed broadcast join: string+decimal128 build side replicated over
+    ICI, NULL keys never match, results equal the single-chip typed join."""
+    from spark_rapids_tpu import Column, dtypes
+    from spark_rapids_tpu.ops import inner_join
+    from spark_rapids_tpu.parallel import (distributed_broadcast_join_keyed,
+                                           encode_key_columns)
+    mesh = _mesh()
+    rng = np.random.default_rng(77)
+    nl, nr = NDEV * 24, NDEV * 4
+    vocab = ["apple", "banana", None, "cherry", "", "fig", "grape", "kiwi"]
+    ls = [vocab[i % len(vocab)] for i in rng.integers(0, len(vocab), nl)]
+    ld = [int(d) if d % 5 else None
+          for d in rng.integers(0, 3, nl)]
+    rs = [vocab[i % len(vocab)] for i in range(nr)]
+    rd = [int(d) if d % 5 else None for d in rng.integers(0, 3, nr)]
+    lcols = [Column.from_pylist(ls, dtypes.STRING),
+             Column.from_pylist(ld, dtypes.decimal(38, 2))]
+    rcols = [Column.from_pylist(rs, dtypes.STRING),
+             Column.from_pylist(rd, dtypes.decimal(38, 2))]
+    lv = np.arange(nl, dtype=np.int64)
+    rv = np.arange(nr, dtype=np.int64) + 1000
+
+    l_words, specs = encode_key_columns(lcols, max_bytes=[8, None])
+    r_words, _ = encode_key_columns(rcols, max_bytes=[8, None])
+    sh = NamedSharding(mesh, P("data"))
+    put = lambda x: jax.device_put(jnp.asarray(x), sh)  # noqa: E731
+    out_lw, (out_lv,), (out_rv,), valid, overflow = \
+        distributed_broadcast_join_keyed(
+            mesh, [put(w) for w in l_words], [put(lv)],
+            [put(w) for w in r_words], [put(rv)], specs,
+            row_cap=4 * nl // NDEV)
+    assert not bool(jnp.any(overflow))
+    m = np.asarray(valid)
+    got = sorted(zip(np.asarray(out_lv)[m].tolist(),
+                     np.asarray(out_rv)[m].tolist()))
+    # oracle: the single-chip typed join (NULL keys never match there too)
+    lmap, rmap = inner_join(lcols, rcols)
+    want = sorted(zip(lv[np.asarray(lmap.data)].tolist(),
+                      (rv[np.asarray(rmap.data)]).tolist()))
+    assert got == want and len(got) > 0
