@@ -54,7 +54,7 @@ from ..columnar import Column, Table
 from .sort import _key_operands
 
 __all__ = ["inner_join", "left_join", "left_semi_join", "left_anti_join",
-           "inner_join_capped", "semi_join_mask",
+           "inner_join_capped", "left_join_capped", "semi_join_mask",
            "join_spans", "expand_spans"]
 
 
@@ -142,12 +142,18 @@ def _join_kernel(operands, lvalid, rvalid, *, n_ops: int, nl: int,
 
 
 @partial(jax.jit, static_argnames=("total", "outer"))
-def _expand(counts, lo, rorder, *, total: int, outer: bool):
+def _expand(counts, lo, rorder, *, total: int, outer: bool, eff=None):
+    """`eff`, if given, is the per-row EMIT count (overrides the default
+    outer rule of max(counts, 1)): rows with eff 0 produce no output slot,
+    so a caller excluding rows (an alive mask) gets a live-slot prefix with
+    no permute — output slots are allocated to emitting rows in row order
+    by the exclusive scan."""
     nl = counts.shape[0]
     if nl == 0:     # static: empty left side expands to all-dead slots
         return (jnp.zeros((total,), jnp.int32),
                 jnp.full((total,), -1, jnp.int32))
-    eff = jnp.maximum(counts, 1) if outer else counts
+    if eff is None:
+        eff = jnp.maximum(counts, 1) if outer else counts
     starts = jnp.cumsum(eff) - eff            # exclusive scan
     # which left row produced output slot j: repeat row ids by their counts
     # (jnp.repeat with a static total lowers to cumsum + a sorted-unique
@@ -182,11 +188,14 @@ def join_spans(operands, lvalid, rvalid, *, nl: int, need_rorder: bool = True):
                         nl=nl, need_rorder=need_rorder)
 
 
-def expand_spans(counts, lo, rorder, *, total: int, outer: bool = False):
+def expand_spans(counts, lo, rorder, *, total: int, outer: bool = False,
+                 eff=None):
     """PUBLIC padded span expansion (companion to join_spans): materialize
     (left row, right row) gather maps into a fixed `total` slots; under
-    `outer` every left row emits >=1 slot and unmatched rows get right -1."""
-    return _expand(counts, lo, rorder, total=total, outer=outer)
+    `outer` every left row emits >=1 slot and unmatched rows get right -1.
+    `eff` overrides the per-row emit count (rows with eff 0 emit nothing —
+    the alive-mask idiom; see _expand)."""
+    return _expand(counts, lo, rorder, total=total, outer=outer, eff=eff)
 
 
 def _prep(left_keys, right_keys, null_equal: bool, need_rorder: bool = True,
@@ -279,6 +288,34 @@ def inner_join_capped(left_keys, right_keys, row_cap: int, *,
     lmap = jnp.where(valid, lmap, 0)
     rmap = jnp.where(valid, jnp.clip(rmap, 0, max(nr - 1, 0)), 0)
     return lmap, rmap, valid, total > row_cap
+
+
+def left_join_capped(left_keys, right_keys, row_cap: int, *,
+                     lalive=None, ralive=None, null_equal: bool = False):
+    """Jit-traceable left-outer equi-join (the outer sibling of
+    inner_join_capped): every ALIVE left row emits at least one output
+    slot; unmatched rows get right -1, surfaced as `rvalid` False. Rows
+    excluded by `lalive` emit nothing — dead rows are permuted to the end
+    of the expansion frame (the shard-local join tail's trick) so live
+    output slots stay a prefix under the static cap.
+
+    Returns (lmap, rmap, rvalid, valid, overflow): (row_cap,) int32 gather
+    maps (dead/unmatched slots clamped to 0), rvalid marking slots whose
+    right side is real, valid marking live slots, and the overflow flag."""
+    counts, lo, rorder = _prep(_cols(left_keys), _cols(right_keys),
+                               null_equal, lalive=lalive, ralive=ralive)
+    eff = jnp.maximum(counts, 1)
+    if lalive is not None:
+        eff = jnp.where(lalive, eff, 0)   # excluded rows emit nothing
+    total = jnp.sum(eff.astype(jnp.int64))
+    lmap, rmap = _expand(counts, lo, rorder, total=row_cap, outer=True,
+                         eff=eff)
+    valid = jnp.arange(row_cap, dtype=jnp.int32) < total
+    rvalid = valid & (rmap >= 0)
+    nr = _cols(right_keys)[0].length
+    lmap = jnp.where(valid, lmap, 0)
+    rmap = jnp.where(rvalid, jnp.clip(rmap, 0, max(nr - 1, 0)), 0)
+    return lmap, rmap, rvalid, valid, total > row_cap
 
 
 def semi_join_mask(left_keys, right_keys, *, lalive=None, ralive=None,

@@ -380,22 +380,18 @@ def _local_join_tail(lk, lv, lalive, rk, rv, ralive, row_cap: int,
     lmatch = lalive if lmatch is None else lmatch
     rmatch = ralive if rmatch is None else rmatch
     nl = lks[0].shape[0]
-    if outer:
-        # dead (padded) rows also get an output slot under outer expansion's
-        # eff=max(counts,1): push them to the END so live slots form a
-        # prefix that a single `< total_live` mask selects
-        order = jnp.argsort(~lalive, stable=True)
-        lks = [jnp.take(k, order, axis=0) for k in lks]
-        lvs = [jnp.take(v, order, axis=0) for v in lvs]
-        lalive = jnp.take(lalive, order, axis=0)
-        lmatch = jnp.take(lmatch, order, axis=0)
     operands = tuple(jnp.concatenate([a, b]) for a, b in zip(lks, rks))
     counts, lo, rorder = join_spans(operands, lmatch, rmatch, nl=nl)
-    lsel, rsel = expand_spans(counts, lo, rorder, total=row_cap, outer=outer)
     if outer:
-        total = jnp.sum(jnp.where(lalive, jnp.maximum(counts, 1), 0))
+        # dead (padded) rows emit NOTHING: a zero emit count keeps live
+        # output slots a prefix with no dead-rows-last permute
+        eff = jnp.where(lalive, jnp.maximum(counts, 1), 0)
+        total = jnp.sum(eff)
     else:
+        eff = None
         total = jnp.sum(counts)
+    lsel, rsel = expand_spans(counts, lo, rorder, total=row_cap, outer=outer,
+                              eff=eff)
     live = jnp.arange(row_cap, dtype=jnp.int32) < total
     rmatched = rsel >= 0 if outer else jnp.ones((row_cap,), bool)
     out_lks = [jnp.where(live, jnp.take(k, lsel, axis=0), 0) for k in lks]

@@ -542,3 +542,121 @@ def test_inner_join_capped_edges_and_string_keys():
         [(0, 0), (2, 0), (4, 1)]
     assert np.asarray(semi_join_mask([ls], [rs])).tolist() == \
         [True, False, True, False, True]
+
+
+def test_left_join_capped_matches_eager():
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops import left_join_capped
+    rng = np.random.default_rng(43)
+    nl, nr = 2000, 300
+    lk = col(rng.integers(0, 400, nl).astype(np.int64),
+             nulls=rng.random(nl) < 0.1)
+    rk = col(rng.integers(0, 400, nr).astype(np.int64))
+    ref_l, ref_r = left_join([lk], [rk])
+    rl, rr = np.asarray(ref_l.data), np.asarray(ref_r.data)
+    ref = sorted(zip(rl.tolist(),
+                     [int(x) if x >= 0 else None for x in rr]))
+
+    lmap, rmap, rvalid, valid, overflow = jax.jit(
+        lambda l, r: left_join_capped([l], [r], row_cap=nl * 4))(lk, rk)
+    assert not bool(overflow)
+    m = np.asarray(valid)
+    rv = np.asarray(rvalid)[m]
+    got = sorted(zip(np.asarray(lmap)[m].tolist(),
+                     [int(x) if ok else None
+                      for x, ok in zip(np.asarray(rmap)[m], rv)]))
+    assert got == ref
+    # lalive: excluded left rows emit NOTHING (vs unmatched rows, which
+    # emit null-extended)
+    lalive = jnp.asarray(np.asarray(lk.data) % 2 == 0)
+    lmap2, rmap2, rvalid2, valid2, ovf2 = left_join_capped(
+        [lk], [rk], row_cap=nl * 4, lalive=lalive)
+    assert not bool(ovf2)
+    m2 = np.asarray(valid2)
+    la = np.asarray(lalive)
+    want2 = sorted((l, r) for l, r in ref if la[l])
+    got2 = sorted(zip(np.asarray(lmap2)[m2].tolist(),
+                      [int(x) if ok else None
+                       for x, ok in zip(np.asarray(rmap2)[m2],
+                                        np.asarray(rvalid2)[m2])]))
+    assert got2 == want2
+    # too-small cap flags
+    *_, ovf3 = left_join_capped([lk], [rk], row_cap=8)
+    assert bool(ovf3)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_capped_tier_fuzz_matches_eager(seed):
+    """Randomized parity: capped inner/left/semi/groupby against their
+    eager forms over random shapes, mixed dtypes (int64/string keys),
+    nulls, and random caps — the fuzz-tier pattern of the reference's
+    monte-carlo harness applied to the jit tier."""
+    from spark_rapids_tpu.ops import (groupby_aggregate_capped,
+                                      inner_join_capped, left_join_capped,
+                                      semi_join_mask)
+    import jax.numpy as jnp
+    rng = np.random.default_rng(100 + seed)
+    nl = int(rng.integers(1, 900))
+    nr = int(rng.integers(1, 300))
+    nk = int(rng.integers(1, 60))
+    use_strings = bool(rng.integers(0, 2))
+    if use_strings:
+        vocab = [f"k{i}" for i in range(nk)] + [None]
+        lk = scol([vocab[i] for i in rng.integers(0, len(vocab), nl)])
+        rk = scol([vocab[i] for i in rng.integers(0, len(vocab), nr)])
+    else:
+        lk = col(rng.integers(0, nk, nl).astype(np.int64),
+                 nulls=rng.random(nl) < 0.15)
+        rk = col(rng.integers(0, nk, nr).astype(np.int64),
+                 nulls=rng.random(nr) < 0.15)
+
+    # inner
+    el, er = inner_join([lk], [rk])
+    cap = max(int(el.length * 2), 16)
+    lm, rm, v, o = inner_join_capped([lk], [rk], row_cap=cap)
+    assert not bool(o)
+    m = np.asarray(v)
+    assert sorted(zip(np.asarray(lm)[m].tolist(),
+                      np.asarray(rm)[m].tolist())) == \
+        sorted(zip(np.asarray(el.data).tolist(),
+                   np.asarray(er.data).tolist()))
+    # left
+    el2, er2 = left_join([lk], [rk])
+    cap2 = max(int(el2.length * 2), 16)
+    lm2, rm2, rv2, v2, o2 = left_join_capped([lk], [rk], row_cap=cap2)
+    assert not bool(o2)
+    m2 = np.asarray(v2)
+    got = sorted(zip(np.asarray(lm2)[m2].tolist(),
+                     [int(x) if ok else None for x, ok in
+                      zip(np.asarray(rm2)[m2], np.asarray(rv2)[m2])]))
+    want = sorted(zip(np.asarray(el2.data).tolist(),
+                      [int(x) if x >= 0 else None
+                       for x in np.asarray(er2.data)]))
+    assert got == want
+    # semi mask
+    keep = left_semi_join([lk], [rk])
+    wantm = np.zeros(nl, bool)
+    wantm[np.asarray(keep.data)] = True
+    np.testing.assert_array_equal(
+        np.asarray(semi_join_mask([lk], [rk])), wantm)
+    # groupby with random alive mask (int64 values)
+    vals = col(rng.integers(-1000, 1000, nl).astype(np.int64))
+    alive = rng.random(nl) < 0.8
+    t = Table([lk, vals], names=["k", "v"])
+    kc = max(nk + 2, 8)
+    out, gvalid, govf = groupby_aggregate_capped(
+        t, ["k"], [("v", "sum"), ("v", "count")], key_cap=kc,
+        alive=jnp.asarray(alive))
+    assert not bool(govf)
+    from spark_rapids_tpu.ops import apply_boolean_mask
+    eager = groupby_aggregate(apply_boolean_mask(t, jnp.asarray(alive)),
+                              ["k"], [("v", "sum"), ("v", "count")])
+    gm = np.asarray(gvalid)
+    assert gm.sum() == eager.num_rows
+    np.testing.assert_array_equal(
+        np.asarray(out.columns[1].data)[gm],
+        np.asarray(eager.columns[1].data))
+    np.testing.assert_array_equal(
+        np.asarray(out.columns[2].data)[gm],
+        np.asarray(eager.columns[2].data))
