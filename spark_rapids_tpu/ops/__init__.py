@@ -30,7 +30,7 @@ from .map_utils import from_json
 from .gather import take, take_table, apply_boolean_mask
 from .sort import sort_table_capped, sorted_order, sort_table
 from .aggregate import groupby_aggregate, groupby_aggregate_capped
-from .join import (inner_join, inner_join_capped, left_join,
+from .join import (full_join, inner_join, inner_join_capped, left_join,
                    left_join_capped,
                    left_semi_join, left_anti_join, semi_join_mask)
 from .copying import (concat_columns, concat_tables, slice_table,
@@ -71,7 +71,7 @@ _ADMITTED_FACTORS = {
     "sorted_order": 2.0, "sort_table": 3.0, "sort_table_capped": 3.0,
     "groupby_aggregate": 2.0, "groupby_aggregate_capped": 2.0,
     "inner_join": 3.0, "inner_join_capped": 3.0, "left_join": 3.0,
-    "left_join_capped": 3.0,
+    "left_join_capped": 3.0, "full_join": 3.0,
     "left_semi_join": 2.0, "left_anti_join": 2.0, "semi_join_mask": 2.0,
     # slice/split/halve are deliberately NOT admitted: they run inside the
     # SplitAndRetry recovery path when memory is already short, and their
@@ -112,6 +112,7 @@ __all__ = [
     "sort_table_capped",
     "groupby_aggregate", "groupby_aggregate_capped",
     "inner_join", "inner_join_capped", "left_join", "left_join_capped",
+    "full_join",
     "left_semi_join",
     "left_anti_join", "semi_join_mask",
     "concat_columns", "concat_tables", "slice_table", "split_table",

@@ -53,7 +53,8 @@ from .. import dtypes
 from ..columnar import Column, Table
 from .sort import _key_operands
 
-__all__ = ["inner_join", "left_join", "left_semi_join", "left_anti_join",
+__all__ = ["inner_join", "left_join", "full_join", "left_semi_join",
+           "left_anti_join",
            "inner_join_capped", "left_join_capped", "semi_join_mask",
            "join_spans", "expand_spans"]
 
@@ -295,9 +296,9 @@ def left_join_capped(left_keys, right_keys, row_cap: int, *,
     """Jit-traceable left-outer equi-join (the outer sibling of
     inner_join_capped): every ALIVE left row emits at least one output
     slot; unmatched rows get right -1, surfaced as `rvalid` False. Rows
-    excluded by `lalive` emit nothing — dead rows are permuted to the end
-    of the expansion frame (the shard-local join tail's trick) so live
-    output slots stay a prefix under the static cap.
+    excluded by `lalive` emit nothing — a zero per-row emit count drops
+    them from the expansion entirely, so live output slots stay a prefix
+    under the static cap with no permute (see _expand's `eff`).
 
     Returns (lmap, rmap, rvalid, valid, overflow): (row_cap,) int32 gather
     maps (dead/unmatched slots clamped to 0), rvalid marking slots whose
@@ -328,6 +329,23 @@ def semi_join_mask(left_keys, right_keys, *, lalive=None, ralive=None,
     counts, _, _ = _prep(_cols(left_keys), _cols(right_keys), null_equal,
                          need_rorder=False, lalive=lalive, ralive=ralive)
     return counts > 0
+
+
+def full_join(left_keys, right_keys,
+              null_equal: bool = False) -> Tuple[Column, Column]:
+    """Full outer join: left_join's output plus one (-1, j) row per
+    UNMATCHED right row j (cudf::full_join's gather-map contract; take()
+    turns the -1s into null rows on either side). The unmatched-right set
+    comes from one swapped-sides span pass (counts only, no expansion)."""
+    lmap, rmap = left_join(left_keys, right_keys, null_equal)
+    extra = left_anti_join(right_keys, left_keys, null_equal).data
+    n_extra = int(extra.shape[0])
+    total = lmap.length + n_extra
+    ldata = jnp.concatenate([lmap.data,
+                             jnp.full((n_extra,), -1, jnp.int32)])
+    rdata = jnp.concatenate([rmap.data, extra])
+    return (Column(dtype=dtypes.INT32, length=total, data=ldata),
+            Column(dtype=dtypes.INT32, length=total, data=rdata))
 
 
 def left_semi_join(left_keys, right_keys,

@@ -660,3 +660,40 @@ def test_capped_tier_fuzz_matches_eager(seed):
     np.testing.assert_array_equal(
         np.asarray(out.columns[2].data)[gm],
         np.asarray(eager.columns[2].data))
+
+
+def test_full_join_matches_multiset_oracle():
+    from spark_rapids_tpu.ops import full_join, take
+    rng = np.random.default_rng(47)
+    nl, nr = 800, 300
+    lkv = rng.integers(0, 250, nl).astype(np.int64)
+    rkv = rng.integers(0, 250, nr).astype(np.int64)
+    lnull = rng.random(nl) < 0.1
+    lk = col(lkv, nulls=lnull)
+    rk = col(rkv)
+    lmap, rmap = full_join([lk], [rk])
+    lkey = take(lk, lmap.data).to_pylist()
+    rkey = take(rk, rmap.data).to_pylist()
+
+    # multiset oracle with Spark/cudf semantics: null keys never match
+    # (each null-keyed left row emits unmatched; a pandas outer merge would
+    # wrongly match null==null)
+    import collections
+    lcnt = collections.Counter(int(v) for v, b in zip(lkv, lnull) if not b)
+    rcnt = collections.Counter(int(v) for v in rkv)
+    want = []
+    for k in set(lcnt) | set(rcnt):
+        if lcnt[k] and rcnt[k]:
+            want += [(k, k)] * (lcnt[k] * rcnt[k])
+        elif lcnt[k]:
+            want += [(k, None)] * lcnt[k]
+        else:
+            want += [(None, k)] * rcnt[k]
+    want += [(None, None)] * int(lnull.sum())   # null left keys: unmatched
+    want = sorted(want, key=lambda t: (t[0] is None, t[0] or 0,
+                                       t[1] is None, t[1] or 0))
+    # got pairs are (left key, right key); unmatched sides are None
+    got_pairs = sorted(zip(lkey, rkey),
+                       key=lambda t: (t[0] is None, t[0] or 0,
+                                      t[1] is None, t[1] or 0))
+    assert got_pairs == want
