@@ -29,6 +29,25 @@ def main(argv=None):
                    jit=False)  # output size is data-dependent (one host
                                # sync); the kernel itself is jitted in-op
 
+        # capped jit tier: static key_cap output, zero host syncs.
+        # min(n_keys, n_rows) keeps smoke-scale caps meaningful (distinct
+        # groups are bounded by rows at tiny scales, not the key space)
+        from spark_rapids_tpu.ops import groupby_aggregate_capped
+        cap = max(2 * min(n_keys, n_rows), 16)
+
+        def capped(tb, cap=cap):
+            out, valid, overflow = groupby_aggregate_capped(
+                tb, ["k"], [("v", "sum"), ("v", "count")], key_cap=cap)
+            # return every output so XLA cannot dead-code the aggregation
+            return [c.data for c in out.columns], valid, overflow
+
+        import jax
+        # a cap overflow would silently time truncated garbage: check once
+        assert not bool(jax.jit(capped)(t)[2]), "key_cap overflow"
+        run_config("groupby_sum_count_capped",
+                   {"num_rows": n_rows, "num_keys": n_keys, "key_cap": cap},
+                   capped, (t,), n_rows=n_rows, iters=args.iters, jit=True)
+
 
 if __name__ == "__main__":
     main()

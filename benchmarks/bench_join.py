@@ -27,6 +27,18 @@ def main(argv=None):
                (lk, rk), n_rows=nl, iters=args.iters,
                jit=False)  # match count is data-dependent; kernels jitted in-op
 
+    # capped jit tier: the whole join is ONE compiled program, no host sync
+    # (~1 match/left row by construction: cap 2x covers it)
+    from spark_rapids_tpu.ops import inner_join_capped
+    import jax
+    # a cap overflow would silently time truncated garbage: check once
+    assert not bool(jax.jit(lambda l, r: inner_join_capped(
+        [l], [r], row_cap=2 * nl))(lk, rk)[3]), "row_cap overflow"
+    run_config("inner_join_capped", {"left_rows": nl, "right_rows": nr,
+                                     "row_cap": 2 * nl},
+               lambda l, r: inner_join_capped([l], [r], row_cap=2 * nl),
+               (lk, rk), n_rows=nl, iters=args.iters, jit=True)
+
 
 if __name__ == "__main__":
     main()
