@@ -20,9 +20,12 @@ from typing import Callable, Dict, Tuple
 
 import jax.numpy as jnp
 
-from .relational import (distributed_groupby, distributed_groupby_keyed,
+from .relational import (distributed_broadcast_join,
+                         distributed_broadcast_join_keyed,
+                         distributed_groupby, distributed_groupby_keyed,
                          distributed_inner_join, distributed_inner_join_keyed,
-                         distributed_left_join, distributed_sort)
+                         distributed_left_join, distributed_left_join_keyed,
+                         distributed_sort)
 
 
 class CapacityOverflowError(RuntimeError):
@@ -113,6 +116,42 @@ def distributed_left_join_auto(mesh, lkeys, lvals, rkeys, rvals,
             mesh, lkeys, lvals, rkeys, rvals, row_cap=row_cap, slack=slack,
             axis=axis),
         {"row_cap": row_cap, "slack": slack}, max_attempts)
+    return out
+
+
+def distributed_left_join_keyed_auto(mesh, l_words, lvals, r_words, rvals,
+                                     key_specs, row_cap: int,
+                                     slack: float = 2.0, axis: str = "data",
+                                     max_attempts: int = 6):
+    out, _ = auto_retry_overflow(
+        lambda row_cap, slack: distributed_left_join_keyed(
+            mesh, l_words, lvals, r_words, rvals, key_specs,
+            row_cap=row_cap, slack=slack, axis=axis),
+        {"row_cap": row_cap, "slack": slack}, max_attempts)
+    return out
+
+
+def distributed_broadcast_join_auto(mesh, lkeys, lvals, rkeys, rvals,
+                                    row_cap: int, axis: str = "data",
+                                    max_attempts: int = 6):
+    """Broadcast joins have no shuffle spill (the build side is replicated
+    whole), so only row_cap grows on overflow."""
+    out, _ = auto_retry_overflow(
+        lambda row_cap: distributed_broadcast_join(
+            mesh, lkeys, lvals, rkeys, rvals, row_cap=row_cap, axis=axis),
+        {"row_cap": row_cap}, max_attempts)
+    return out
+
+
+def distributed_broadcast_join_keyed_auto(mesh, l_words, lvals, r_words,
+                                          rvals, key_specs, row_cap: int,
+                                          axis: str = "data",
+                                          max_attempts: int = 6):
+    out, _ = auto_retry_overflow(
+        lambda row_cap: distributed_broadcast_join_keyed(
+            mesh, l_words, lvals, r_words, rvals, key_specs,
+            row_cap=row_cap, axis=axis),
+        {"row_cap": row_cap}, max_attempts)
     return out
 
 

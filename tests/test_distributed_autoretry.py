@@ -104,3 +104,25 @@ def test_retries_exhausted_raises():
     with pytest.raises(CapacityOverflowError):
         auto_retry_overflow(attempt, {"cap": 2}, max_attempts=3)
     assert calls == [2, 4, 8]
+
+
+def test_broadcast_join_auto_grows_row_cap():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from spark_rapids_tpu.parallel import distributed_broadcast_join_auto
+    mesh = _mesh()
+    ndev = mesh.devices.size
+    nl = ndev * 8
+    lk = np.zeros(nl, np.int64)           # every left row matches all right
+    lv = np.arange(nl, dtype=np.int64)
+    rk = np.zeros(ndev, np.int64)
+    rv = np.arange(ndev, dtype=np.int64)
+    sh = NamedSharding(mesh, P("data"))
+    args = [jax.device_put(jnp.asarray(x), sh) for x in (lk, lv, rk, rv)]
+    # row_cap=4 per shard overflows (8*ndev matches/shard); auto grows it
+    out_lk, out_lv, out_rv, valid, overflow = distributed_broadcast_join_auto(
+        mesh, *args, row_cap=4)
+    assert not bool(jnp.any(overflow))
+    assert int(jnp.sum(valid)) == nl * ndev
