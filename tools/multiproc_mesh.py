@@ -25,8 +25,10 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-N_PROCS = 2
-LOCAL_DEVICES = 4
+# geometry is env-parametrized so CI can prove N>2 processes too
+# (default 2x4; the v5p north star is 16 hosts x 4 chips)
+N_PROCS = int(os.environ.get("SRT_MULTIPROC_PROCS", "2"))
+LOCAL_DEVICES = int(os.environ.get("SRT_MULTIPROC_LOCAL_DEVICES", "4"))
 
 
 def worker(pid: int, port: int) -> None:
