@@ -161,10 +161,25 @@ def main(argv=None):
         r = q23_capped(s, {"catalog": c, "web": w})
         return r["total"], r["overflow"]
 
-    run_config("nds_q23_pipeline", {"num_rows": n_total}, run,
+    # renamed from "nds_q23_pipeline" (round-5 ADVICE: engine-conflating name)
+    run_config("nds_q23_pipeline_capped", {"num_rows": n_total}, run,
                (store, sides["catalog"], sides["web"]),
                n_rows=n_total, iters=args.iters,
-               jit=True)    # capped static-shape tier: one XLA program
+               jit=True,    # capped static-shape tier: one XLA program
+               impl="capped_jit")
+
+    from spark_rapids_tpu.plan import PlanExecutor
+    from benchmarks.nds_plans import q23_inputs, q23_plan
+    ex = PlanExecutor(mode="capped", caps=dict(key_cap=8192))
+    plan, inputs = q23_plan(), q23_inputs(store, sides)
+
+    def prun():
+        res = ex.execute(plan, inputs)
+        return [c.data for c in res.table.columns], res.valid
+
+    run_config("nds_q23_pipeline_plan", {"num_rows": n_total}, prun, (),
+               n_rows=n_total, iters=args.iters, jit=False,
+               impl="plan_capped")
 
 
 if __name__ == "__main__":

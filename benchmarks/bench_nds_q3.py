@@ -152,9 +152,29 @@ def main(argv=None):
     jrun = jax.jit(run)
     if bool(jrun(sales, dates, items)[2]):
         raise RuntimeError("cap overflow: datagen selectivity changed")
-    run_config("nds_q3_pipeline", {"num_sales": n_sales, **caps}, jrun,
-               (sales, dates, items), n_rows=n_sales, iters=args.iters,
-               jit=False)   # already jitted above
+    # renamed from "nds_q3_pipeline" (round-5 ADVICE): the old name covered
+    # both the eager and the capped engine across revisions
+    run_config("nds_q3_pipeline_capped", {"num_sales": n_sales, **caps},
+               jrun, (sales, dates, items), n_rows=n_sales,
+               iters=args.iters, jit=False,   # already jitted above
+               impl="capped_jit")
+
+    # the same query through the plan engine's capped tier (generic
+    # operator DAG; materializes each join frame instead of composing
+    # gather maps — the A/B that prices the declarative layer)
+    from spark_rapids_tpu.plan import PlanExecutor
+    from benchmarks.nds_plans import q3_inputs, q3_plan
+    ex = PlanExecutor(mode="capped",
+                      caps=dict(row_cap=caps["row_cap1"], key_cap=4096))
+    plan, inputs = q3_plan(), q3_inputs(sales, dates, items)
+
+    def prun():
+        res = ex.execute(plan, inputs)
+        return [c.data for c in res.table.columns], res.valid
+
+    run_config("nds_q3_pipeline_plan", {"num_sales": n_sales}, prun, (),
+               n_rows=n_sales, iters=args.iters, jit=False,
+               impl="plan_capped")
 
 
 def jax_flatten(res):

@@ -210,10 +210,25 @@ def main(argv=None):
         out, valid, overflow = q5_capped(t, a[-1])
         return [c.data for c in out.columns], valid, overflow
 
-    run_config("nds_q5_pipeline", {"num_rows": n_total}, run,
+    # renamed from "nds_q5_pipeline" (round-5 ADVICE: engine-conflating name)
+    run_config("nds_q5_pipeline_capped", {"num_rows": n_total}, run,
                tuple(x for pair in tabs.values() for x in pair) + (dates,),
                n_rows=n_total, iters=args.iters,
-               jit=True)    # capped static-shape tier: one XLA program
+               jit=True,    # capped static-shape tier: one XLA program
+               impl="capped_jit")
+
+    from spark_rapids_tpu.plan import PlanExecutor
+    from benchmarks.nds_plans import q5_inputs, q5_plan
+    ex = PlanExecutor(mode="capped", caps=dict(key_cap=2048))
+    plan, inputs = q5_plan(), q5_inputs(tabs, dates)
+
+    def prun():
+        res = ex.execute(plan, inputs)
+        return [c.data for c in res.table.columns], res.valid
+
+    run_config("nds_q5_pipeline_plan", {"num_rows": n_total}, prun, (),
+               n_rows=n_total, iters=args.iters, jit=False,
+               impl="plan_capped")
 
 
 if __name__ == "__main__":

@@ -198,9 +198,26 @@ def main(argv=None):
     jrun = jax.jit(run)
     if bool(jrun(*tabs)[2]):
         raise RuntimeError("cap overflow: datagen selectivity changed")
-    run_config("nds_q72_pipeline", {"num_sales": n, **caps}, jrun,
+    # renamed from "nds_q72_pipeline" (round-5 ADVICE: engine-conflating name)
+    run_config("nds_q72_pipeline_capped", {"num_sales": n, **caps}, jrun,
                tabs, n_rows=n, iters=args.iters,
-               jit=False)   # already jitted above
+               jit=False,   # already jitted above
+               impl="capped_jit")
+
+    from spark_rapids_tpu.plan import PlanExecutor
+    from benchmarks.nds_plans import q72_inputs, q72_plan
+    ex = PlanExecutor(mode="capped",
+                      caps=dict(row_cap=caps["row_cap"],
+                                key_cap=caps["key_cap"]))
+    plan, inputs = q72_plan(), q72_inputs(*tabs)
+
+    def prun():
+        res = ex.execute(plan, inputs)
+        return [c.data for c in res.table.columns], res.valid
+
+    run_config("nds_q72_pipeline_plan", {"num_sales": n}, prun, (),
+               n_rows=n, iters=args.iters, jit=False,
+               impl="plan_capped")
 
 
 if __name__ == "__main__":

@@ -110,13 +110,19 @@ def steady_state_ms(fn: Callable, args, iters: int, platform: str) -> float:
 
 
 def run_config(bench: str, axes: Dict, fn: Callable, args, *, n_rows: int,
-               iters: int = 10, jit: bool = True) -> Dict:
+               iters: int = 10, jit: bool = True,
+               impl: str = None) -> Dict:
     """Time fn(*args) steady-state; returns + prints the result record.
 
     `jit=True` measures the op as deployed — one compiled XLA program
     (nvbench likewise times the kernel, not per-op dispatch). Ops whose
     output shapes are data-dependent must either take static bounds from the
-    bench or pass jit=False. Timing methodology: `steady_state_ms`."""
+    bench or pass jit=False. Timing methodology: `steady_state_ms`.
+
+    `impl` names the measured engine/tier (e.g. "capped_jit",
+    "plan_capped") and is recorded on the JSONL row, so cross-revision
+    history never conflates two engines under one bench name again
+    (round-5 ADVICE: the nds_q* configs silently switched engines)."""
     if jit:
         fn = jax.jit(fn)
     out = fn(*args)
@@ -124,6 +130,8 @@ def run_config(bench: str, axes: Dict, fn: Callable, args, *, n_rows: int,
     ms = steady_state_ms(fn, args, iters, jax.default_backend())
     rec = {"bench": bench, "axes": axes, "ms": round(ms, 3),
            "rows_per_s": round(n_rows / (ms * 1e-3))}
+    if impl is not None:
+        rec["impl"] = impl
     if getattr(steady_state_ms, "last_upper_bound", False):
         rec["ms_upper_bound"] = True    # sync round-trip folded in; see
         # steady_state_ms noise-floor fallback

@@ -1,0 +1,166 @@
+"""The four NDS pipelines as physical plans (spark_rapids_tpu.plan).
+
+One source of truth for the plan-engine form of q3/q5/q23/q72, imported by
+BOTH the `_plan` bench configs (bench_nds_q*.py) and the parity tests
+(tests/test_plan_nds.py) — the same no-drift contract the hand-wired `q3`
+has with test_nds_query.py. Each builder returns a validated Plan whose
+EAGER execution matches the hand-wired eager pipeline row for row, and
+whose CAPPED execution (one XLA program, plan-level cap escalation) agrees
+with the eager result after compaction.
+
+Shapes worth noticing:
+- q3/q72: star joins as chained HashJoin nodes; q72's inventory join uses
+  the COMPOSITE (item, week) key — the physical plan a CBO picks, and the
+  shape that keeps the capped tier fan-out-free (see q72_capped).
+- q5: per-channel Union → semi-join date window → rollup via a shared
+  Union feeding two aggregates (channel subtotals + the const-key grand
+  total).
+- q23: the two expensive subqueries are SHARED DAG nodes — both sides
+  semi-join the same `freq`/`best` objects, so the executor computes each
+  once per run (the subquery-reuse that is the whole point of q23); the
+  best-customer HAVING uses a scalar-aggregate expression
+  (`> 0.95 * scalar_max(rev)`).
+"""
+import sys
+
+sys.path.insert(0, ".")
+
+from spark_rapids_tpu.plan import (PlanBuilder, col, lit,  # noqa: E402
+                                   scalar_max)
+
+
+def q3_plan():
+    b = PlanBuilder()
+    sales = b.scan("sales", schema=["sold_date_sk", "item_sk", "price_cents"])
+    dates = (b.scan("dates", schema=["d_date_sk", "d_year", "d_moy"])
+             .filter(col("d_moy") == 11))
+    items = (b.scan("items", schema=["i_item_sk", "i_brand", "i_manufact"])
+             .filter(col("i_manufact") == 42))
+    j = (sales.join(dates, left_on="sold_date_sk", right_on="d_date_sk")
+              .join(items, left_on="item_sk", right_on="i_item_sk"))
+    return (j.aggregate(["d_year", "i_brand"],
+                        [("price_cents", "sum", "revenue")])
+             .sort(["d_year", "revenue"], ascending=[True, False])
+             .build())
+
+
+def q5_plan():
+    from benchmarks.bench_nds_q5 import DATE_HI, DATE_LO
+    b = PlanBuilder()
+    dates = (b.scan("dates", schema=["d_date_sk"])
+             .filter((col("d_date_sk") >= DATE_LO) &
+                     (col("d_date_sk") < DATE_HI)))
+    sums = [("sales", "sum", "sales"), ("returns", "sum", "returns"),
+            ("profit", "sum", "profit"), ("loss", "sum", "loss")]
+    per = []
+    for ci, name in enumerate(("store", "catalog", "web")):
+        s = b.scan(f"{name}_sales",
+                   schema=["sk", "date_sk", "sales_price", "profit"])
+        r = b.scan(f"{name}_returns",
+                   schema=["sk", "date_sk", "return_amt", "net_loss"])
+        s_rows = s.project([("sk", col("sk")), ("date_sk", col("date_sk")),
+                            ("sales", col("sales_price")),
+                            ("profit", col("profit")),
+                            ("returns", lit(0)), ("loss", lit(0))])
+        r_rows = r.project([("sk", col("sk")), ("date_sk", col("date_sk")),
+                            ("sales", lit(0)), ("profit", lit(0)),
+                            ("returns", col("return_amt")),
+                            ("loss", col("net_loss"))])
+        u = (s_rows.union(r_rows)
+             .join(dates, left_on="date_sk", right_on="d_date_sk",
+                   how="left_semi"))
+        g = (u.aggregate(["sk"], sums)
+              .project([("channel", lit(ci))] +
+                       [(n, col(n)) for n in ("sk", "sales", "returns",
+                                              "profit", "loss")]))
+        per.append(g)
+    allch = PlanBuilder.union(per)
+    sub = allch.aggregate(["channel"], sums)
+    tot = (allch.project([("channel", lit(-1))] +
+                         [(n, col(n)) for n in ("sales", "returns",
+                                                "profit", "loss")])
+                .aggregate(["channel"], sums))
+    return (sub.union(tot)
+               .sort(["channel", "sales"], ascending=[True, False])
+               .build())
+
+
+def q23_plan():
+    from benchmarks.bench_nds_q23 import BEST_FRACTION, FREQ_THRESHOLD
+    b = PlanBuilder()
+    schema = ["item_sk", "cust_sk", "qty", "price"]
+    store = b.scan("store", schema=schema)
+    # subquery 1: frequent items — shared by both sides below
+    freq = (store.aggregate(["item_sk"], [("qty", "count", "cnt")])
+                 .filter(col("cnt") > FREQ_THRESHOLD))
+    # subquery 2: best customers, HAVING sum > fraction * MAX(sum) — the
+    # scalar-subquery expression evaluates over live groups only
+    best = (store.project([("cust_sk", col("cust_sk")),
+                           ("rev", col("qty") * col("price"))])
+                 .aggregate(["cust_sk"], [("rev", "sum", "rev")])
+                 .filter(col("rev") >
+                         lit(BEST_FRACTION) * scalar_max(col("rev"))))
+    side_totals = []
+    for name in ("catalog", "web"):
+        side = b.scan(name, schema=schema)
+        tot = (side.join(freq, left_on="item_sk", right_on="item_sk",
+                         how="left_semi")
+                   .join(best, left_on="cust_sk", right_on="cust_sk",
+                         how="left_semi")
+                   .project([("rev", col("qty") * col("price"))])
+                   .aggregate([], [("rev", "sum", "total")]))
+        side_totals.append(tot)
+    return (side_totals[0].union(side_totals[1])
+            .aggregate([], [("total", "sum", "total")])
+            .build())
+
+
+def q72_plan():
+    b = PlanBuilder()
+    cs = b.scan("cs", schema=["item_sk", "hd_sk", "sold_date_sk",
+                              "ship_days", "qty"])
+    inv = b.scan("inv", schema=["inv_item_sk", "inv_week", "inv_wh_sk",
+                                "inv_qty"])
+    items = b.scan("items", schema=["i_item_sk", "i_brand"])
+    hd = (b.scan("hd", schema=["hd_demo_sk", "hd_buy_potential"])
+          .filter(col("hd_buy_potential") == 3))
+    wh = b.scan("wh", schema=["w_warehouse_sk"])
+    dates = (b.scan("dates", schema=["d_date_sk", "d_week", "d_year"])
+             .filter(col("d_year") == 1))
+    j = (cs.join(hd, "hd_sk", "hd_demo_sk")
+           .join(items, "item_sk", "i_item_sk")
+           .join(dates, "sold_date_sk", "d_date_sk")
+           .filter(col("ship_days") > 5)
+           # composite (item, week) key: one inventory row per combo, so
+           # the join is fan-out-free (same rows as item-join + week filter)
+           .join(inv, ["i_item_sk", "d_week"], ["inv_item_sk", "inv_week"])
+           .filter(col("inv_qty") < col("qty"))
+           .join(wh, "inv_wh_sk", "w_warehouse_sk"))
+    return (j.aggregate(["i_item_sk", "w_warehouse_sk", "d_week"],
+                        [("qty", "size", "cnt")])
+             .sort(["cnt", "i_item_sk", "w_warehouse_sk", "d_week"],
+                   ascending=[False, True, True, True])
+             .build())
+
+
+# ---- input bindings ---------------------------------------------------------
+
+def q3_inputs(sales, dates, items):
+    return {"sales": sales, "dates": dates, "items": items}
+
+
+def q5_inputs(tabs, dates):
+    out = {"dates": dates}
+    for name, (s, r) in tabs.items():
+        out[f"{name}_sales"] = s
+        out[f"{name}_returns"] = r
+    return out
+
+
+def q23_inputs(store, sides):
+    return {"store": store, **sides}
+
+
+def q72_inputs(cs, inv, items, hd, wh, dates):
+    return {"cs": cs, "inv": inv, "items": items, "hd": hd, "wh": wh,
+            "dates": dates}

@@ -5,6 +5,17 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# native warning gate: new -Wall/-Wextra diagnostics in load-bearing native
+# code fail the nightly before anything else runs
+python - <<'PY'
+from spark_rapids_tpu.native.build import check_warnings
+warns = check_warnings()
+if warns:
+    print("native warnings detected:\n" + "\n".join(warns))
+    raise SystemExit(1)
+print("native warning gate: clean")
+PY
+
 python -m pytest tests/ -q -m ""    # include the nightly-marked tier
 python benchmarks/run_all.py --scale 0.01 --iters 5 --cpu
 ./ci/fuzz-test.sh

@@ -13,6 +13,10 @@ idiomatic JAX/XLA/Pallas/PJRT stack:
   TPU equivalent of SparkResourceAdaptor (SURVEY.md §2.2).
 - `parallel`: device-mesh sharding + ICI/DCN all-to-all partition exchange
   (the slot the GPU stack fills with UCX shuffle).
+- `plan`: physical-plan subsystem — typed operator DAG (Scan/Filter/…/
+  HashJoin/HashAggregate/Exchange) over Table, validating builder, and an
+  executor with eager / capped-jit / distributed tiers, per-operator
+  metrics (explain/profile) and plan-granularity cap escalation.
 - `io`: native parquet footer parse/prune/filter + chunked page reader.
 - `interop`: Arrow C Data Interface export/import (JVM-facing surface).
 - `faultinj`: config-driven fault injection over the device-call surface.
@@ -34,7 +38,7 @@ __all__ = ["dtypes", "Column", "Table", "api", "__version__", "version_info"]
 
 
 _LAZY_SUBMODULES = ("api", "ops", "parallel", "io", "runtime", "interop",
-                    "columnar", "faultinj", "config")
+                    "columnar", "faultinj", "config", "plan")
 
 
 def __getattr__(name):

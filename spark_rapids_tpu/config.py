@@ -13,6 +13,7 @@ equivalents, all read at use time (not import time) so tests can monkeypatch:
 | SPARK_RAPIDS_TPU_TRACE           | 0    | profiler ranges (utils/tracing)|
 | TPU_FAULT_INJECTOR_CONFIG_PATH   | —    | fault injector config (faultinj)|
 | SPARK_RAPIDS_TPU_ROW_CONVERSION_KERNEL | auto | auto/word/concat (ops/row_conversion) |
+| SPARK_RAPIDS_TPU_GROUPBY_KERNEL  | auto | auto/scan/scatter (ops/aggregate) |
 """
 from __future__ import annotations
 
@@ -52,4 +53,18 @@ def row_conversion_kernel() -> str:
         raise ValueError(
             f"SPARK_RAPIDS_TPU_ROW_CONVERSION_KERNEL={v!r}: expected "
             "auto, word, or concat")
+    return v
+
+
+def groupby_kernel() -> str:
+    """Groupby aggregation kernel selection: auto (default: scan design on
+    TPU where scatters are ~25x a cumsum, scatter/segment design on CPU
+    where the scan design measured ~2x slower — see ops/aggregate.py), or
+    force "scan" / "scatter". Same strict-typo policy as
+    row_conversion_kernel."""
+    v = os.environ.get("SPARK_RAPIDS_TPU_GROUPBY_KERNEL", "auto")
+    if v not in ("auto", "scan", "scatter"):
+        raise ValueError(
+            f"SPARK_RAPIDS_TPU_GROUPBY_KERNEL={v!r}: expected auto, scan, "
+            "or scatter")
     return v

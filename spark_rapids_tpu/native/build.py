@@ -22,15 +22,40 @@ _SOURCES = {
     "arrow_c_consumer": ["arrow_c_consumer.cpp"],
 }
 
-# extra link flags per lib (page decompression codecs; libsnappy ships no
-# dev symlink in this image, hence the -l: literal form)
+# extra link flags per lib (page decompression codecs; libsnappy/libzstd ship
+# no dev symlink in this image, hence the -l: literal forms)
 _LDFLAGS = {
-    "parquet_reader": ["-lz", "-lzstd", "-l:libsnappy.so.1"],
+    "parquet_reader": ["-lz", "-l:libzstd.so.1", "-l:libsnappy.so.1"],
 }
 
 
 def lib_path(name: str) -> str:
     return os.path.join(_HERE, f"lib{name}.so")
+
+
+def check_warnings() -> list:
+    """Compile every native lib fresh with the REAL build flags (same -O2
+    etc. as build(), so optimizer-dependent diagnostics like
+    -Wmaybe-uninitialized can fire) plus -Wall -Wextra, and return the
+    diagnostics for any lib that warns (empty = clean). ci/nightly.sh
+    fails on a non-empty result, so new warnings in load-bearing native
+    code cannot silently accumulate. Output goes to a temp file: the
+    cached .so files and their mtimes are untouched."""
+    import tempfile
+    out = []
+    with tempfile.TemporaryDirectory() as tmp:
+        for name, srcs in _SOURCES.items():
+            cmd = ["g++", "-std=c++17", "-O2", "-g", "-fPIC", "-shared",
+                   "-pthread", "-Wall", "-Wextra",
+                   "-o", os.path.join(tmp, f"lib{name}.so")] + \
+                [os.path.join(_HERE, s) for s in srcs] + \
+                _LDFLAGS.get(name, [])
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                out.append(f"{name}: compile failed:\n{proc.stderr}")
+            elif "warning:" in proc.stderr:
+                out.append(f"{name}:\n{proc.stderr}")
+    return out
 
 
 def build(name: str) -> str:

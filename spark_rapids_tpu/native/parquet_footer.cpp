@@ -216,7 +216,7 @@ struct Writer {
       case CT_LIST:
       case CT_SET: {
         uint64_t n = v.elems.size();
-        uint8_t et = v.elem_type ? v.elem_type : CT_STRUCT;
+        uint8_t et = v.elem_type ? v.elem_type : uint8_t(CT_STRUCT);
         if (n < 15) u8(uint8_t((n << 4) | et));
         else { u8(uint8_t(0xF0 | et)); uvarint(n); }
         for (auto const& e : v.elems) {

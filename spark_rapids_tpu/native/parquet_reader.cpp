@@ -37,7 +37,18 @@
 #include <vector>
 
 #include <zlib.h>
+
+// libzstd.so.1 may ship without its dev header (like snappy below); the two
+// calls used here have a stable C ABI, so declare them when zstd.h is absent.
+#if __has_include(<zstd.h>)
 #include <zstd.h>
+#else
+extern "C" {
+size_t ZSTD_decompress(void* dst, size_t dst_capacity, void const* src,
+                       size_t compressed_size);
+unsigned ZSTD_isError(size_t code);
+}
+#endif
 
 // libsnappy.so.1 ships no header in this image; declaring the exact C++
 // signatures reproduces the mangled symbols.

@@ -811,7 +811,7 @@ class ResourceArbiter {
     std::map<int64_t, int64_t> bufn_pool_threads_per_task;
   };
 
-  DeadlockScan scan_for_deadlock(std::unique_lock<std::mutex> const& lock)
+  DeadlockScan scan_for_deadlock(std::unique_lock<std::mutex> const& /*held*/)
   {
     DeadlockScan out;
     std::unordered_set<int64_t> blocked_tasks;
@@ -887,7 +887,7 @@ class ResourceArbiter {
     }
   }
 
-  void wake_after_task_finish(int64_t self, std::unique_lock<std::mutex> const& lock)
+  void wake_after_task_finish(int64_t self, std::unique_lock<std::mutex> const& /*held*/)
   {
     // A task finished → progress was made. Restart all plain-BLOCKED threads;
     // only if there were none, restart the BUFN family too.
@@ -916,7 +916,7 @@ class ResourceArbiter {
   // Returns true when a normally-RUNNING task thread was fully removed (the
   // signal used to decide whether finishing it should wake other threads).
   bool remove_thread_association(int64_t tid, int64_t remove_task_id, int64_t self,
-                                 std::unique_lock<std::mutex> const& lock)
+                                 std::unique_lock<std::mutex> const& /*held*/)
   {
     auto it = threads_.find(tid);
     if (it == threads_.end()) return false;

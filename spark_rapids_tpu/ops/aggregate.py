@@ -67,7 +67,9 @@ def _groupby_kernel(key_operands, agg_datas, agg_valids, *, n_ops: int,
     against ~233 ms per tuple-carry scan (primitives.jsonl), so this design
     measures ~0.49× the old scatter-based kernel there (tools/
     ab_relational.jsonl) — the win this layout buys exists on TPU, where
-    scatters are ~25× a cumsum. The
+    scatters are ~25× a cumsum; `_use_scan_kernel` therefore dispatches
+    the segment/scatter design (_groupby_kernel_scatter) on CPU, so CPU
+    users no longer pay the regression. The
     previous kernel did one value gather per aggregation plus 4 positional
     gathers per cumsum-difference — gathers dominated (~0.9 s at 10M). This
     version has zero data-sized gathers:
@@ -272,6 +274,138 @@ def _groupby_kernel(key_operands, agg_datas, agg_valids, *, n_ops: int,
     return num_groups, starts, first_rows, outs
 
 
+@partial(jax.jit,
+         static_argnames=("n_ops", "agg_kinds", "has_valids", "has_alive"))
+def _groupby_kernel_scatter(key_operands, agg_datas, agg_valids, *,
+                            n_ops: int, agg_kinds: Tuple[str, ...],
+                            has_valids: Tuple[bool, ...],
+                            has_alive: bool = False):
+    """Scatter/segment-op groupby kernel — the CPU-preferred design.
+
+    Same contract as _groupby_kernel (the scan design): (num_groups,
+    starts, first_rows, outs), group order = key sort order, padding past
+    num_groups sliced/masked by the caller. The difference is the
+    aggregation step: after the ONE main key sort, per-sorted-row group ids
+    come from a cumsum of the run boundaries and every aggregate is one
+    `jax.ops.segment_{sum,min,max}` — a data-sized random scatter-add.
+    That is the round-3 design this file replaced for TPU, kept here
+    because the tradeoff is BACKEND-SPECIFIC (tools/primitives.jsonl, CPU:
+    scatter-add ~163 ms vs ~233 ms per tuple-carry scan at 10M rows; the
+    scan design measured ~0.49x the scatter kernel on CPU in tools/
+    ab_relational.jsonl). `_use_scan_kernel` picks per backend, like
+    row_conversion's _use_word_kernel.
+
+    Dead rows under `has_alive` sort last as their own groups (the leading
+    flag operand differs), so their segment ids land past every alive
+    group and their results fall in the sliced-away tail."""
+    n = key_operands[0].shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+
+    payloads: List = []
+    slots: List[Tuple[Optional[int], Optional[int]]] = []
+    for data, valid, op, hv in zip(agg_datas, agg_valids, agg_kinds,
+                                   has_valids):
+        d_slot = v_slot = None
+        if op not in ("size", "count"):
+            d_slot = len(payloads)
+            payloads.append(data)
+        if hv:
+            v_slot = len(payloads)
+            payloads.append(valid.astype(jnp.int8))
+        slots.append((d_slot, v_slot))
+
+    sorted_all = jax.lax.sort([*key_operands, iota, *payloads],
+                              num_keys=n_ops, is_stable=True)
+    sorted_ops = sorted_all[:n_ops]
+    order = sorted_all[n_ops]
+    spay = sorted_all[n_ops + 1:]
+
+    neq = jnp.zeros((n,), bool)
+    for o in sorted_ops:
+        neq = neq | (o != jnp.roll(o, 1))
+    boundary = neq.at[0].set(True) if n else neq
+    if has_alive:
+        num_groups = jnp.sum((boundary & (sorted_ops[0] == 0))
+                             .astype(jnp.int32))
+    else:
+        num_groups = jnp.sum(boundary.astype(jnp.int32))
+
+    # group id per sorted row; groups numbered in sorted-key order, so the
+    # per-group results land directly in compaction order
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    # stable sort => order is increasing within a group: min(order) is the
+    # group's FIRST row, and min(position) its start
+    starts = jax.ops.segment_min(iota, seg, num_segments=n)
+    first_rows = jax.ops.segment_min(order, seg, num_segments=n)
+    sizes = jax.ops.segment_sum(jnp.ones((n,), jnp.int64), seg,
+                                num_segments=n)
+
+    outs = []
+    for (d_slot, v_slot), op in zip(slots, agg_kinds):
+        ok = (spay[v_slot] == 1) if v_slot is not None else None
+        okv = ok if ok is not None else jnp.ones((n,), bool)
+        cnt = None
+        if op != "size":
+            cnt = jax.ops.segment_sum(okv.astype(jnp.int64), seg,
+                                      num_segments=n)
+        if op == "size":
+            outs.append((sizes, None))
+            continue
+        if op == "count":
+            outs.append((cnt, None))
+            continue
+        v = spay[d_slot]
+        if op in ("sum", "mean"):
+            if v.dtype.kind == "f" or op == "mean":
+                acc = jnp.where(okv, v.astype(jnp.float64), 0.0)
+                s = jax.ops.segment_sum(acc, seg, num_segments=n)
+                if op == "mean":
+                    s = s / jnp.where(cnt == 0, 1, cnt).astype(jnp.float64)
+                outs.append((s, cnt > 0))
+            else:
+                acc = jnp.where(okv, v.astype(jnp.int64), jnp.int64(0))
+                outs.append((jax.ops.segment_sum(acc, seg, num_segments=n),
+                             cnt > 0))
+            continue
+        # min / max with null-ignoring identities; floats via the same
+        # total-order transform + bit cast back as the scan kernel
+        is_float = v.dtype.kind == "f"
+        if is_float:
+            from .sort import _float_total_order
+            tv = _float_total_order(v)
+        else:
+            tv = v
+        info = jnp.iinfo(tv.dtype)
+        ident = jnp.asarray(info.max if op == "min" else info.min, tv.dtype)
+        masked = jnp.where(okv, tv, ident)
+        ext = (jax.ops.segment_min(masked, seg, num_segments=n)
+               if op == "min"
+               else jax.ops.segment_max(masked, seg, num_segments=n))
+        if is_float:
+            sign_bit = jnp.asarray(info.min, ext.dtype)
+            bits = jnp.where(ext < 0, ~(ext ^ sign_bit), ext)
+            outs.append((jax.lax.bitcast_convert_type(bits, v.dtype),
+                         cnt > 0))
+        else:
+            outs.append((ext, cnt > 0))
+
+    return num_groups, starts, first_rows, outs
+
+
+def _use_scan_kernel() -> bool:
+    """Backend dispatch for the groupby kernel (see _groupby_kernel vs
+    _groupby_kernel_scatter — the scan design wins on TPU where scatters
+    are ~25x a cumsum, the segment/scatter design wins ~2x on CPU).
+    Override: SPARK_RAPIDS_TPU_GROUPBY_KERNEL=scan|scatter."""
+    from ..config import groupby_kernel
+    mode = groupby_kernel()
+    if mode == "scan":
+        return True
+    if mode == "scatter":
+        return False
+    return jax.default_backend() != "cpu"
+
+
 def groupby_aggregate(table: Table,
                       key_names: Sequence[Union[int, str]],
                       aggs: Sequence[Tuple[Union[int, str], str]],
@@ -334,7 +468,9 @@ def groupby_aggregate(table: Table,
             agg_valids.append(c.validity)
         agg_kinds.append(op)
 
-    num_groups, first_sorted, first_rows_full, outs = _groupby_kernel(
+    kernel = _groupby_kernel if _use_scan_kernel() else \
+        _groupby_kernel_scatter
+    num_groups, first_sorted, first_rows_full, outs = kernel(
         tuple(operands), tuple(agg_datas), tuple(agg_valids),
         n_ops=len(operands), agg_kinds=tuple(agg_kinds),
         has_valids=tuple(v is not None for v in agg_valids),

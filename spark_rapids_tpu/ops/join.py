@@ -263,6 +263,19 @@ def left_join(left_keys, right_keys,
             Column(dtype=dtypes.INT32, length=total, data=rmap))
 
 
+def _require_x64(op_name: str) -> None:
+    """The capped joins' total-match guard sums counts in int64; with
+    jax_enable_x64 off, `astype(jnp.int64)` silently degrades to int32 and
+    the overflow flag wraps at 2^31 total matches. The flag is enabled at
+    package import, but a host app embedding this engine can flip it back —
+    fail loudly instead of corrupting the guard."""
+    if not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            f"{op_name} requires jax_enable_x64 (enabled at spark_rapids_tpu "
+            "import): its match-count overflow guard sums in int64 and would "
+            "silently wrap at 2^31 matches under 32-bit mode")
+
+
 def inner_join_capped(left_keys, right_keys, row_cap: int, *,
                       lalive=None, ralive=None, null_equal: bool = False):
     """Jit-traceable inner equi-join: a static `row_cap` output instead of
@@ -278,6 +291,7 @@ def inner_join_capped(left_keys, right_keys, row_cap: int, *,
     Returns (lmap, rmap, valid, overflow): (row_cap,) int32 gather maps into
     the original frames (dead slots hold 0 and are masked by `valid`), a
     (row_cap,) bool row mask, and a scalar overflow flag."""
+    _require_x64("inner_join_capped")
     counts, lo, rorder = _prep(_cols(left_keys), _cols(right_keys),
                                null_equal, lalive=lalive, ralive=ralive)
     total = jnp.sum(counts.astype(jnp.int64))   # i32 sum could wrap at 10M×
@@ -303,6 +317,7 @@ def left_join_capped(left_keys, right_keys, row_cap: int, *,
     Returns (lmap, rmap, rvalid, valid, overflow): (row_cap,) int32 gather
     maps (dead/unmatched slots clamped to 0), rvalid marking slots whose
     right side is real, valid marking live slots, and the overflow flag."""
+    _require_x64("left_join_capped")
     counts, lo, rorder = _prep(_cols(left_keys), _cols(right_keys),
                                null_equal, lalive=lalive, ralive=ralive)
     eff = jnp.maximum(counts, 1)

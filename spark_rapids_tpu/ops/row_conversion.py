@@ -124,6 +124,20 @@ def _word_plan(dts: Sequence[dtypes.DType]):
     return tuple(words), validity_offset, row_size
 
 
+def _require_untraced_f64(data) -> None:
+    """Both row-image kernels lower FLOAT64 through a HOST-SIDE numpy view
+    on non-CPU backends (the TPU X64 pass has no bitcast *from* f64), which
+    is impossible on traced data. Raise a clear error instead of the
+    TracerArrayConversionError numpy would throw."""
+    if isinstance(data, jax.core.Tracer):
+        raise NotImplementedError(
+            "convert_to_rows over a FLOAT64 column cannot run inside an "
+            "outer jax.jit on this backend: the f64 word image is built "
+            "from a host-side numpy view (no f64 bitcast in the X64 pass), "
+            "which traced data cannot provide. Call the op eagerly, or "
+            "convert the column to INT64 bits on the host first.")
+
+
 def _column_words(col: Column):
     """(n, w//4) uint32 LE word image of a >=4-byte column's data."""
     data = col.data
@@ -132,6 +146,7 @@ def _column_words(col: Column):
         return data                     # already (n, 4) LE u32 limbs
     if kind == dtypes.Kind.FLOAT64 and jax.default_backend() != "cpu":
         # the TPU X64 pass has no bitcast *from* f64 — take the view host-side
+        _require_untraced_f64(data)
         return jnp.asarray(np.asarray(data).view("<u4").reshape(-1, 2))
     out = jax.lax.bitcast_convert_type(data, jnp.uint32)
     return out.reshape(-1, 1) if out.ndim == 1 else out
@@ -202,6 +217,7 @@ def _column_bytes(col: Column) -> jnp.ndarray:
         return data.astype(jnp.uint8).reshape(-1, 1)
     if col.dtype.kind == dtypes.Kind.FLOAT64 and jax.default_backend() != "cpu":
         # the TPU X64 pass has no bitcast *from* f64 — take the view host-side
+        _require_untraced_f64(data)
         return jnp.asarray(np.asarray(data).view(np.uint8).reshape(-1, 8))
     return jax.lax.bitcast_convert_type(data, jnp.uint8)
 
@@ -236,7 +252,15 @@ def _to_rows_concat_kernel(datas, masks, *, layout):
 
 
 def convert_to_rows(table: Table) -> List[Column]:
-    """Table -> row-major LIST<UINT8> column (RowConversion.convertToRows)."""
+    """Table -> row-major LIST<UINT8> column (RowConversion.convertToRows).
+
+    Jit caveat (non-CPU backends only): a FLOAT64 column's byte/word image
+    is built from a HOST-SIDE numpy view in BOTH kernels (the TPU X64 pass
+    has no bitcast from f64), so this op cannot be wrapped in an outer
+    `jax.jit` when the table has f64 columns — it raises a clear
+    NotImplementedError under tracing instead of numpy's
+    TracerArrayConversionError — and each f64 column costs one
+    device-to-host sync in eager use there. CPU is unaffected."""
     cols = list(table.columns)
     dts = [c.dtype for c in cols]
     n = table.num_rows

@@ -44,7 +44,10 @@ def _hist_kernel(P: int, TM: int):
         acc = counts_ref[...]                                # (8, 128) i32
         # bucket b lives at (sublane 0, lane b); P block-reduces, unrolled
         for b in range(P):
-            c = jnp.sum(jnp.where(blk == b, jnp.int32(1), jnp.int32(0)))
+            # dtype pinned: some jax versions promote sum(int32) to int64
+            # under x64, and a Pallas ref store rejects the widened value
+            c = jnp.sum(jnp.where(blk == b, jnp.int32(1), jnp.int32(0)),
+                        dtype=jnp.int32)
             acc = acc + jnp.where((sub == 0) & (lane == b), c, jnp.int32(0))
         counts_ref[...] = acc
 

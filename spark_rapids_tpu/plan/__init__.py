@@ -1,0 +1,42 @@
+"""Physical-plan subsystem: the declarative operator layer between the
+plugin-facing API and the `ops`/`parallel` kernel tiers.
+
+The reference stack receives *plans* from Spark's Catalyst optimizer and
+lowers them operator-by-operator onto libcudf ("Accelerating Presto with
+GPUs" makes the same argument for a declarative operator layer above native
+kernels; StreamBox-HBM uses per-operator pipelines as the unit of memory
+arbitration — PAPERS.md). Before this subsystem every NDS query hand-wired
+operator sequencing, cap management and retry; now a query is a `Plan` — a
+DAG of typed operator nodes over `columnar.Table` — and the engine-side
+concerns live in ONE executor:
+
+- `nodes` / `expr`: the operator set (Scan, Filter, Project, HashJoin,
+  HashAggregate, Sort, Exchange, Limit, Union) and the expression
+  mini-language predicates/projections are written in.
+- `builder`: fluent, validating construction (`PlanBuilder`); schema and
+  reference errors surface at build time as `PlanValidationError`.
+- `executor`: walks the DAG composing the public `ops` kernels (eager tier)
+  or traces the whole plan into ONE capped XLA program (jit tier) with
+  geometric cap escalation via `parallel.autoretry` at plan granularity;
+  admission (`runtime.admission`), `faultinj` interception and
+  `utils.tracing` ranges apply per operator.
+- `metrics`: `explain()` (pre-run plan tree) and `profile()` (post-run
+  per-operator rows/bytes/wall-time/retry counts).
+
+See docs/plan.md for the operator contract and how a JVM/plugin front-end
+targets this layer.
+"""
+from .expr import col, lit, scalar_max, scalar_min, scalar_sum, Expr
+from .nodes import (Exchange, Filter, HashAggregate, HashJoin, Limit,
+                    PlanNode, Project, Scan, Sort, Union)
+from .builder import Plan, PlanBuilder, PlanValidationError
+from .executor import PlanExecutor, PlanResult
+from .metrics import OperatorMetrics
+
+__all__ = [
+    "col", "lit", "scalar_max", "scalar_min", "scalar_sum", "Expr",
+    "Scan", "Filter", "Project", "HashJoin", "HashAggregate", "Sort",
+    "Exchange", "Limit", "Union", "PlanNode",
+    "Plan", "PlanBuilder", "PlanValidationError",
+    "PlanExecutor", "PlanResult", "OperatorMetrics",
+]

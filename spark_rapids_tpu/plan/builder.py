@@ -1,0 +1,201 @@
+"""Validating plan builder: fluent construction + whole-DAG validation.
+
+`PlanBuilder` hands out `Rel` wrappers whose chained methods append operator
+nodes; `Rel.build()` (or `Plan(root)`) validates the whole DAG bottom-up —
+schema resolution, expression references, join-key arity, agg ops — and
+raises `PlanValidationError` with the offending node's label. Scans with
+declared schemas validate fully at build time; undeclared scans defer the
+checks of their subtree to execute(), where the bound tables provide the
+real schemas (both paths run the same `output_names` contract).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union as TUnion
+
+from .expr import Expr
+from .nodes import (Exchange, Filter, HashAggregate, HashJoin, Limit,
+                    PlanNode, PlanValidationError, Project, Scan, Sort,
+                    Union)
+
+__all__ = ["Plan", "PlanBuilder", "Rel", "PlanValidationError"]
+
+
+def _toposort(root: PlanNode) -> List[PlanNode]:
+    """Children-first order; each DAG-shared node appears exactly once."""
+    order: List[PlanNode] = []
+    seen = set()
+    stack = [(root, False)]
+    while stack:
+        node, expanded = stack.pop()
+        if id(node) in seen:
+            continue
+        if expanded:
+            seen.add(id(node))
+            order.append(node)
+        else:
+            stack.append((node, True))
+            for c in node.children:
+                if id(c) not in seen:
+                    stack.append((c, False))
+    return order
+
+
+class Plan:
+    """A validated operator DAG. `schemas` maps node -> output names for
+    every node whose schema is resolvable from declared scan schemas;
+    execute() re-resolves with the bound inputs."""
+
+    def __init__(self, root: PlanNode):
+        self.root = root
+        self.nodes = _toposort(root)
+        self.scans = [n for n in self.nodes if isinstance(n, Scan)]
+        sources = [s.source for s in self.scans]
+        dup = {s for s in sources if sources.count(s) > 1}
+        if dup:
+            raise PlanValidationError(
+                f"multiple Scan nodes bind the same input(s) {sorted(dup)}; "
+                "reuse one Scan node (the DAG executes it once)")
+        self.schemas = self.resolve_schemas(strict=False)
+
+    # ---- validation -------------------------------------------------------
+    def resolve_schemas(self, bound: Optional[Dict[str, Sequence[str]]] = None,
+                        strict: bool = True) -> Dict[int, Tuple[str, ...]]:
+        """node-id -> output names. `bound` gives scan schemas from actual
+        tables (overriding declarations, which are then cross-checked).
+        strict=False skips subtrees fed by undeclared scans instead of
+        raising (build-time pass)."""
+        bound = bound or {}
+        out: Dict[int, Tuple[str, ...]] = {}
+        for node in self.nodes:
+            if isinstance(node, Scan):
+                schema = bound.get(node.source, node.schema)
+                if schema is None and not strict:
+                    continue
+                if schema is None:
+                    raise PlanValidationError(
+                        f"{node.label}: input {node.source!r} is not bound "
+                        f"and no schema was declared")
+                schema = tuple(schema)
+                if node.schema is not None and tuple(node.schema) != schema:
+                    raise PlanValidationError(
+                        f"{node.label}: bound table schema {list(schema)} "
+                        f"does not match declared {list(node.schema)}")
+                out[id(node)] = schema
+                continue
+            child_schemas = []
+            ok = True
+            for c in node.children:
+                if id(c) not in out:
+                    ok = False        # fed by an undeclared scan
+                    break
+                child_schemas.append(out[id(c)])
+            if not ok:
+                if strict:
+                    raise PlanValidationError(
+                        f"{node.label}: child schema unresolved")
+                continue
+            out[id(node)] = tuple(node.output_names(child_schemas))
+        return out
+
+    @property
+    def input_names(self) -> List[str]:
+        return [s.source for s in self.scans]
+
+    # ---- explain ----------------------------------------------------------
+    def explain(self) -> str:
+        """Pre-run plan tree (Spark's `EXPLAIN` analogue). DAG-shared nodes
+        print once and are referenced by label afterwards."""
+        lines: List[str] = []
+        printed = set()
+
+        def walk(node: PlanNode, prefix: str, tail: bool, root: bool):
+            if root:
+                head, child_prefix = "", ""
+            else:
+                head = prefix + ("└─ " if tail else "├─ ")
+                child_prefix = prefix + ("   " if tail else "│  ")
+            desc = node.describe()
+            schema = self.schemas.get(id(node))
+            cols = f" -> [{', '.join(schema)}]" if schema is not None else ""
+            if id(node) in printed:
+                lines.append(f"{head}[ref {node.label}]")
+                return
+            printed.add(id(node))
+            lines.append(f"{head}{node.label}"
+                         f"{' ' + desc if desc else ''}{cols}")
+            kids = node.children
+            for i, c in enumerate(kids):
+                walk(c, child_prefix, i == len(kids) - 1, False)
+
+        walk(self.root, "", True, True)
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return f"Plan({self.root.label}, {len(self.nodes)} nodes)"
+
+
+class Rel:
+    """Fluent wrapper over one node; every method returns a new Rel."""
+
+    def __init__(self, node: PlanNode):
+        self.node = node
+
+    def filter(self, predicate: Expr) -> "Rel":
+        return Rel(Filter(self.node, predicate))
+
+    def project(self, exprs: TUnion[Dict[str, Expr],
+                                    Sequence[Tuple[str, Expr]]]) -> "Rel":
+        items = list(exprs.items()) if isinstance(exprs, dict) else list(exprs)
+        return Rel(Project(self.node, tuple(items)))
+
+    def select(self, names: Sequence[str]) -> "Rel":
+        from .expr import col
+        return self.project([(n, col(n)) for n in names])
+
+    def join(self, other: "Rel", left_on: TUnion[str, Sequence[str]],
+             right_on: TUnion[str, Sequence[str], None] = None,
+             how: str = "inner", row_cap: Optional[int] = None) -> "Rel":
+        lk = (left_on,) if isinstance(left_on, str) else tuple(left_on)
+        if right_on is None:
+            rk = lk
+        else:
+            rk = (right_on,) if isinstance(right_on, str) else tuple(right_on)
+        return Rel(HashJoin(self.node, other.node, lk, rk, how=how,
+                            row_cap=row_cap))
+
+    def aggregate(self, keys: Sequence[str],
+                  aggs: Sequence[Tuple[str, str, str]],
+                  key_cap: Optional[int] = None) -> "Rel":
+        return Rel(HashAggregate(self.node, tuple(keys),
+                                 tuple(tuple(a) for a in aggs),
+                                 key_cap=key_cap))
+
+    def sort(self, keys: Sequence[str],
+             ascending: TUnion[bool, Sequence[bool]] = True) -> "Rel":
+        asc = ((ascending,) * len(keys) if isinstance(ascending, bool)
+               else tuple(ascending))
+        return Rel(Sort(self.node, tuple(keys), asc))
+
+    def limit(self, n: int) -> "Rel":
+        return Rel(Limit(self.node, n))
+
+    def exchange(self, keys: Sequence[str] = ()) -> "Rel":
+        return Rel(Exchange(self.node, tuple(keys)))
+
+    def union(self, *others: "Rel") -> "Rel":
+        return Rel(Union((self.node,) + tuple(o.node for o in others)))
+
+    def build(self) -> Plan:
+        return Plan(self.node)
+
+
+class PlanBuilder:
+    """Entry point: `scan()` leaves, then chain on the returned Rel."""
+
+    def scan(self, source: str,
+             schema: Optional[Sequence[str]] = None) -> Rel:
+        return Rel(Scan(source, None if schema is None else tuple(schema)))
+
+    @staticmethod
+    def union(rels: Sequence[Rel]) -> Rel:
+        return Rel(Union(tuple(r.node for r in rels)))

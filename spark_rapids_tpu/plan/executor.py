@@ -1,0 +1,583 @@
+"""Plan executor: walks the operator DAG and runs it on one of three tiers.
+
+- `mode="eager"`: per-operator dispatch through the public `ops` kernels —
+  every operator gets its own wall-clock, rows/bytes metrics, a
+  `utils.tracing` range, a plan-level faultinj interception point, and a
+  bounded re-run on recoverable injected faults (the plan-level retry that
+  replaces per-query hand-wiring).
+- `mode="capped"`: the whole DAG traces into ONE XLA program with static
+  capacities (`row_cap` for joins, `key_cap` for aggregates — per-node
+  overrides take precedence). A too-small cap raises the overflow flag and
+  `parallel.autoretry.auto_retry_overflow` grows every cap geometrically
+  and re-traces — SplitAndRetry at PLAN granularity, not per-call. The
+  compiled program is cached per (plan, caps), so escalated caps are
+  remembered for the rest of the job.
+- distributed (eager tier only — the constructor rejects a mesh with
+  mode="capped"): when a device `mesh` is given, a `HashAggregate` sitting
+  on an `Exchange` runs on the `parallel.relational` tier (partial agg →
+  all-to-all → final agg) with the same geometric escalation via
+  `distributed_groupby`'s overflow contract.
+
+Admission (`runtime.admission`) applies per operator automatically: the
+executor calls the public `ops` surface through module attribute lookup, so
+the admission wrappers — and any installed faultinj shims — intercept every
+kernel the plan dispatches. Pass `session=` to scope a DeviceSession to the
+execution without touching process-global state.
+
+Results carry `profile()` — per-operator rows (live rows in the capped
+tier, computed on-device and returned with the result), output buffer
+bytes, wall time, retry and cap-escalation counts.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes
+from ..columnar import Column, Table
+from .builder import Plan
+from .metrics import OperatorMetrics, render_profile
+from .nodes import (Exchange, Filter, HashAggregate, HashJoin, Limit,
+                    PlanNode, PlanValidationError, Project, Scan, Sort,
+                    Union)
+from .expr import ColumnRef
+
+# Recoverable fault types (injected nonfatal device assert / substituted
+# return code). DeviceFatalError deliberately propagates: a dead device
+# must stop the retry loop, that is the whole point of the fatal tier.
+def _recoverable_faults():
+    from .. import faultinj
+    return (faultinj.DeviceAssertError, faultinj.InjectedReturnCode)
+
+
+def _ops():
+    # attribute lookups on the module keep admission + faultinj shims live
+    from .. import ops
+    return ops
+
+
+def _np_dtype_to_dt(np_dt) -> dtypes.DType:
+    m = {"b": dtypes.BOOL, "i1": dtypes.INT8, "i2": dtypes.INT16,
+         "i4": dtypes.INT32, "i8": dtypes.INT64,
+         "f4": dtypes.FLOAT32, "f8": dtypes.FLOAT64}
+    np_dt = np.dtype(np_dt)
+    key = "b" if np_dt.kind == "b" else f"{np_dt.kind}{np_dt.itemsize}"
+    if key not in m:
+        raise PlanValidationError(
+            f"expression produced unsupported dtype {np_dt}")
+    return m[key]
+
+
+def _col_from_array(arr) -> Column:
+    dt = _np_dtype_to_dt(arr.dtype)
+    return Column(dtype=dt, length=int(arr.shape[0]), data=arr)
+
+
+class PlanResult:
+    """Output of one plan execution.
+
+    `table` is the result relation; in the capped tier it is PADDED and
+    `valid` marks the live rows (`compact()` materializes just those).
+    `metrics` maps node label -> OperatorMetrics; `profile()` renders them.
+    """
+
+    def __init__(self, plan: Plan, table: Table,
+                 valid: Optional[jnp.ndarray],
+                 metrics: Dict[str, OperatorMetrics],
+                 mode: str, wall_ms: float, attempts: int = 1,
+                 caps: Optional[Dict[str, int]] = None, retries: int = 0):
+        self.plan = plan
+        self.table = table
+        self.valid = valid
+        self.metrics = metrics
+        self.mode = mode
+        self.wall_ms = wall_ms
+        self.attempts = attempts      # capped-tier cap-escalation attempts
+        self.caps = caps              # final (possibly grown) capacities
+        self.retries = retries        # plan-level recoverable-fault re-runs
+
+    def compact(self) -> Table:
+        """Live rows only (identity in the eager tier)."""
+        if self.valid is None:
+            return self.table
+        idx = jnp.asarray(np.nonzero(np.asarray(self.valid))[0],
+                          dtype=jnp.int32)
+        return _ops().take_table(self.table, idx, _has_negative=False)
+
+    def profile(self) -> List[Dict]:
+        """Per-operator metric rows (post-run observability artifact)."""
+        return [m.to_dict() for m in self.metrics.values()]
+
+    def profile_text(self) -> str:
+        return render_profile(list(self.metrics.values()),
+                              plan_wall_ms=self.wall_ms,
+                              attempts=self.attempts, caps=self.caps)
+
+
+class _CappedRel:
+    """A relation inside the capped trace: padded table + live-row mask."""
+
+    __slots__ = ("table", "alive")
+
+    def __init__(self, table: Table, alive: jnp.ndarray):
+        self.table = table
+        self.alive = alive
+
+
+class PlanExecutor:
+    """Executes validated Plans. One executor may run many plans; compiled
+    capped programs are cached per (plan, caps)."""
+
+    def __init__(self, mode: str = "eager",
+                 caps: Optional[Dict[str, int]] = None,
+                 max_cap_attempts: int = 6,
+                 op_retries: int = 2,
+                 mesh=None, mesh_axis: str = "data",
+                 session=None,
+                 block_per_op: bool = True):
+        if mode not in ("eager", "capped"):
+            raise ValueError(f"unknown executor mode {mode!r}")
+        if mesh is not None and mode != "eager":
+            raise ValueError(
+                "distributed lowering (mesh=) exists only in the eager tier "
+                "for now; a capped executor would silently ignore the mesh")
+        self.mode = mode
+        self.caps = dict(caps or {})
+        self.max_cap_attempts = max_cap_attempts
+        self.op_retries = op_retries
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
+        self.session = session
+        self.block_per_op = block_per_op
+        self._jit_cache: Dict[Tuple, Tuple[Callable, Dict, Dict]] = {}
+
+    # ---- entry point ------------------------------------------------------
+    def execute(self, plan: Plan, inputs: Dict[str, Table]) -> PlanResult:
+        missing = [s for s in plan.input_names if s not in inputs]
+        if missing:
+            raise PlanValidationError(f"unbound plan input(s) {missing}")
+        # full validation against the bound tables' actual schemas
+        schemas = plan.resolve_schemas(
+            {name: t.names for name, t in inputs.items()})
+        if self.session is not None:
+            from ..runtime.admission import active_session
+            with active_session(self.session):
+                return self._execute(plan, inputs, schemas)
+        return self._execute(plan, inputs, schemas)
+
+    def _execute(self, plan, inputs, schemas):
+        if self.mode == "eager":
+            return self._execute_eager(plan, inputs, schemas)
+        return self._execute_capped(plan, inputs, schemas)
+
+    def explain(self, plan: Plan) -> str:
+        return plan.explain()
+
+    # ---- faultinj ---------------------------------------------------------
+    @staticmethod
+    def _faultinj_point(node: PlanNode):
+        """Plan-level interception: rules keyed `plan.<Kind>` (or `*`) fire
+        here, in addition to any op-level shims underneath."""
+        from .. import faultinj
+        inj = faultinj.active()
+        if inj is not None:
+            inj.on_compute(f"plan.{node.kind}")
+
+    # ---- eager tier -------------------------------------------------------
+    def _execute_eager(self, plan, inputs, schemas) -> PlanResult:
+        from ..runtime.admission import operand_nbytes
+        from ..utils import tracing
+        t_plan0 = time.perf_counter()
+        results: Dict[int, Table] = {}
+        metrics: Dict[str, OperatorMetrics] = {}
+        for node in plan.nodes:
+            child_tables = [results[id(c)] for c in node.children]
+            m = OperatorMetrics(label=node.label, kind=node.kind,
+                                describe=node.describe())
+            t0 = time.perf_counter()
+            for attempt in range(self.op_retries + 1):
+                try:
+                    with tracing.range_ctx(f"plan.{node.label}"):
+                        self._faultinj_point(node)
+                        out = self._exec_eager_node(node, child_tables,
+                                                    inputs, schemas, m)
+                    break
+                except _recoverable_faults():
+                    if attempt == self.op_retries:
+                        raise
+                    m.retries += 1
+            if self.block_per_op:
+                jax.block_until_ready([c.data for c in out.columns])
+            m.wall_ms = (time.perf_counter() - t0) * 1e3
+            m.rows_in = sum(t.num_rows for t in child_tables)
+            m.rows_out = out.num_rows
+            m.bytes_out = operand_nbytes(out)
+            metrics[node.label] = m
+            results[id(node)] = out
+        wall = (time.perf_counter() - t_plan0) * 1e3
+        return PlanResult(plan, results[id(plan.root)], None, metrics,
+                          "eager", wall)
+
+    def _exec_eager_node(self, node, childs: List[Table], inputs, schemas,
+                         m: OperatorMetrics) -> Table:
+        ops = _ops()
+        if isinstance(node, Scan):
+            return inputs[node.source]
+        if isinstance(node, Filter):
+            (t,) = childs
+            mask = node.predicate.evaluate(t)
+            return ops.apply_boolean_mask(t, mask)
+        if isinstance(node, Project):
+            (t,) = childs
+            return self._project(t, node)
+        if isinstance(node, HashJoin):
+            lt, rt = childs
+            lkeys = [lt[k] for k in node.left_keys]
+            rkeys = [rt[k] for k in node.right_keys]
+            if node.how == "inner":
+                lm, rm = ops.inner_join(lkeys, rkeys)
+                return Table(
+                    list(ops.take_table(lt, lm.data,
+                                        _has_negative=False).columns) +
+                    list(ops.take_table(rt, rm.data,
+                                        _has_negative=False).columns),
+                    names=list(lt.names) + list(rt.names))
+            keep = (ops.left_semi_join(lkeys, rkeys) if node.how == "left_semi"
+                    else ops.left_anti_join(lkeys, rkeys))
+            return ops.take_table(lt, keep.data, _has_negative=False)
+        if isinstance(node, HashAggregate):
+            (t,) = childs
+            if self.mesh is not None and isinstance(node.child, Exchange):
+                return self._exec_distributed_aggregate(node, t, m)
+            if not node.keys:
+                return self._global_aggregate(t, node)
+            agg = ops.groupby_aggregate(t, list(node.keys),
+                                        [(c, o) for c, o, _ in node.aggs])
+            out_names = schemas[id(node)]
+            return Table(list(agg.columns), names=out_names)
+        if isinstance(node, Sort):
+            (t,) = childs
+            return ops.sort_table(t, key_names=list(node.keys),
+                                  ascending=list(node.ascending))
+        if isinstance(node, Limit):
+            (t,) = childs
+            return ops.slice_table(t, 0, min(node.n, t.num_rows))
+        if isinstance(node, Union):
+            return ops.concat_tables(childs)
+        if isinstance(node, Exchange):
+            # single-chip tier: a no-op distribution marker. With a mesh,
+            # the parent operator consumes it (distributed lowering).
+            return childs[0]
+        raise PlanValidationError(f"no eager lowering for {node.kind}")
+
+    def _project(self, t: Table, node: Project,
+                 alive: Optional[jnp.ndarray] = None) -> Table:
+        cols = []
+        for name, e in node.exprs:
+            if isinstance(e, ColumnRef):
+                cols.append(t[e.name])      # preserve dtype + validity
+            else:
+                v = e.evaluate(t, alive)
+                if getattr(v, "ndim", 1) == 0:
+                    # bare scalar aggregate (or literal fold): broadcast to
+                    # the relation's length, as the Expr contract promises
+                    v = jnp.broadcast_to(v, (t.num_rows,))
+                cols.append(_col_from_array(v))
+        return Table(cols, names=[n for n, _ in node.exprs])
+
+    def _global_aggregate(self, t: Table, node: HashAggregate,
+                          alive: Optional[jnp.ndarray] = None) -> Table:
+        """Keyless (one-row) aggregate; honors `alive` in the capped tier."""
+        from ..ops.aggregate import _agg_value_dtype
+        cols, names = [], []
+        for c, op, out_name in node.aggs:
+            if op == "size":
+                n_live = (jnp.sum(alive.astype(jnp.int64)) if alive is not None
+                          else jnp.asarray(t.num_rows, jnp.int64))
+                dt = dtypes.INT64
+                val = n_live
+            else:
+                src = t[c]
+                v = src.data
+                ok = src.validity
+                if alive is not None:
+                    ok = alive if ok is None else (ok & alive)
+                if op == "count":
+                    val = (jnp.sum(ok.astype(jnp.int64)) if ok is not None
+                           else jnp.asarray(t.num_rows, jnp.int64))
+                    dt = dtypes.INT64
+                else:
+                    dt = _agg_value_dtype(op, src.dtype)
+                    acc = v.astype(dt.storage_dtype())
+                    if op == "sum":
+                        if ok is not None:
+                            acc = jnp.where(ok, acc, 0)
+                        val = jnp.sum(acc)
+                    else:
+                        from .expr import _reduce_identity
+                        if ok is not None:
+                            acc = jnp.where(ok, acc,
+                                            _reduce_identity(op, acc.dtype))
+                        val = jnp.min(acc) if op == "min" else jnp.max(acc)
+            cols.append(Column(dtype=dt, length=1,
+                               data=val[None].astype(dt.storage_dtype())))
+            names.append(out_name)
+        return Table(cols, names=names)
+
+    # ---- distributed tier -------------------------------------------------
+    def _exec_distributed_aggregate(self, node: HashAggregate, t: Table,
+                                    m: OperatorMetrics) -> Table:
+        """HashAggregate over Exchange on a mesh: the parallel.relational
+        two-stage SPMD groupby, escalated via auto_retry_overflow."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.autoretry import auto_retry_overflow
+        from ..parallel.relational import distributed_groupby_multi
+        if not node.keys:
+            raise PlanValidationError(
+                f"{node.label}: global aggregate has no distributed form")
+        for k in list(node.keys) + [c for c, o, _ in node.aggs
+                                    if o != "size"]:
+            if t[k].dtype.kind != dtypes.Kind.INT64 or t[k].validity is not None:
+                raise PlanValidationError(
+                    f"{node.label}: distributed aggregate supports non-null "
+                    f"INT64 columns only (got {k!r}: {t[k].dtype})")
+        n_peers = self.mesh.shape[self.mesh_axis]
+        if t.num_rows % n_peers:
+            raise PlanValidationError(
+                f"{node.label}: {t.num_rows} rows not divisible by the "
+                f"{n_peers}-way mesh")
+        val_names, agg_pairs = [], []
+        for c, o, _ in node.aggs:
+            if o in ("count", "size"):
+                agg_pairs.append((0, "count"))
+                continue
+            if o not in ("sum", "min", "max"):
+                raise PlanValidationError(
+                    f"{node.label}: distributed {o!r} unsupported "
+                    "(sum/count/min/max/size)")
+            if c not in val_names:
+                val_names.append(c)
+            agg_pairs.append((val_names.index(c), o))
+        if not val_names:
+            val_names = [node.keys[0]]      # count-only: any carrier column
+        spec = NamedSharding(self.mesh, P(self.mesh_axis))
+        keys = [jax.device_put(t[k].data, spec) for k in node.keys]
+        vals = [jax.device_put(t[v].data, spec) for v in val_names]
+        key_cap = node.key_cap or self.caps.get("key_cap") or max(
+            64, t.num_rows // n_peers)
+        attempts = 0
+
+        def run(key_cap):
+            nonlocal attempts
+            attempts += 1
+            return distributed_groupby_multi(self.mesh, keys, vals,
+                                             agg_pairs, key_cap=key_cap,
+                                             axis=self.mesh_axis)
+        (gks, outs, valid, _), final = auto_retry_overflow(
+            run, {"key_cap": key_cap}, self.max_cap_attempts)
+        m.escalations += attempts - 1
+        mask = np.asarray(valid)
+        cols = [Column(dtype=dtypes.INT64, length=int(mask.sum()),
+                       data=jnp.asarray(np.asarray(k)[mask]))
+                for k in gks]
+        for (i, op), arr in zip(agg_pairs, outs):
+            cols.append(Column(dtype=dtypes.INT64, length=int(mask.sum()),
+                               data=jnp.asarray(np.asarray(arr)[mask])))
+        names = list(node.keys) + [n for _, _, n in node.aggs]
+        return Table(cols, names=names)
+
+    # ---- capped tier ------------------------------------------------------
+    def _default_caps(self, plan, inputs) -> Dict[str, int]:
+        """Initial capacities: the executor's shared caps (defaulted from
+        the largest input) plus one per-node entry for each node-level
+        override — those ride the SAME escalation dict, so an undersized
+        override grows geometrically like everything else instead of
+        livelocking through identical attempts."""
+        caps = dict(self.caps)
+        max_rows = max((t.num_rows for t in inputs.values()), default=1)
+        needs_row = needs_key = False
+        for n in plan.nodes:
+            if isinstance(n, HashJoin) and n.how == "inner":
+                if n.row_cap is None:
+                    needs_row = True
+                else:
+                    caps[f"row_cap:{n.label}"] = n.row_cap
+            elif isinstance(n, HashAggregate) and n.keys:
+                if n.key_cap is None:
+                    needs_key = True
+                else:
+                    caps[f"key_cap:{n.label}"] = n.key_cap
+        if needs_row:
+            caps.setdefault("row_cap", max(max_rows, 1))
+        if needs_key:
+            caps.setdefault("key_cap", max(max_rows, 1))
+        return caps
+
+    @staticmethod
+    def _node_cap(caps: Dict[str, int], which: str, node: PlanNode) -> int:
+        return caps.get(f"{which}:{node.label}") or caps[which]
+
+    def _execute_capped(self, plan, inputs, schemas) -> PlanResult:
+        from ..parallel.autoretry import auto_retry_overflow
+        caps = self._default_caps(plan, inputs)
+        t0 = time.perf_counter()
+        attempts = 0
+        bytes_map: Dict[str, int] = {}
+        last_caps = dict(caps)
+
+        def run(**caps_now):
+            nonlocal attempts
+            attempts += 1
+            last_caps.clear()
+            last_caps.update(caps_now)
+            # plan-level faultinj surface: fires every attempt, including
+            # cache-hit runs where the op-level shims never re-trace
+            for node in plan.nodes:
+                self._faultinj_point(node)
+            fn, bm = self._jitted_capped(plan, schemas, caps_now,
+                                         tuple(sorted(inputs)))
+            out = fn(dict(inputs))
+            bytes_map.clear()
+            bytes_map.update(bm)    # bm fills during the first trace
+            return out
+
+        retries = 0
+        while True:
+            try:
+                (table, valid, counts, overflow), final_caps = \
+                    auto_retry_overflow(run, caps, self.max_cap_attempts)
+                break
+            except _recoverable_faults():
+                if retries >= self.op_retries:
+                    raise
+                retries += 1
+                # resume from the escalated capacities, not the originals:
+                # growth already paid for must survive the fault re-run
+                caps = dict(last_caps)
+        jax.block_until_ready(valid)
+        wall = (time.perf_counter() - t0) * 1e3
+        metrics: Dict[str, OperatorMetrics] = {}
+        # cap growths only: each of the (retries+1) auto_retry runs gets a
+        # free first attempt that is not an escalation
+        escal = max(0, attempts - (retries + 1))
+        counts_np = {k: (int(a), int(b))
+                     for k, (a, b) in zip(counts.keys(),
+                                          np.asarray(list(counts.values()),
+                                                     dtype=np.int64))}
+        for node in plan.nodes:
+            rows_in, rows_out = counts_np[node.label]
+            uses_cap = (isinstance(node, HashJoin) and node.how == "inner") \
+                or (isinstance(node, HashAggregate) and node.keys)
+            metrics[node.label] = OperatorMetrics(
+                label=node.label, kind=node.kind, describe=node.describe(),
+                rows_in=rows_in, rows_out=rows_out,
+                bytes_out=bytes_map.get(node.label, 0),
+                retries=retries, escalations=escal if uses_cap else 0)
+        return PlanResult(plan, table, valid, metrics, "capped", wall,
+                          attempts=attempts, caps=final_caps,
+                          retries=retries)
+
+    def _jitted_capped(self, plan, schemas, caps, input_key):
+        key = (id(plan.root), tuple(sorted(caps.items())), input_key)
+        hit = self._jit_cache.get(key)
+        if hit is not None:
+            return hit
+        bytes_map: Dict[str, int] = {}
+
+        def fn(tables: Dict[str, Table]):
+            return self._run_capped(plan, schemas, caps, tables, bytes_map)
+
+        jitted = jax.jit(fn)
+        self._jit_cache[key] = (jitted, bytes_map)
+        return jitted, bytes_map
+
+    def _run_capped(self, plan, schemas, caps, tables, bytes_map):
+        from ..runtime.admission import operand_nbytes
+        rels: Dict[int, _CappedRel] = {}
+        counts: Dict[str, Tuple] = {}
+        overflow = jnp.asarray(False)
+        for node in plan.nodes:
+            childs = [rels[id(c)] for c in node.children]
+            rel, ovf = self._exec_capped_node(node, childs, tables, schemas,
+                                              caps)
+            if ovf is not None:
+                overflow = overflow | ovf
+            bytes_map[node.label] = operand_nbytes(rel.table)
+            rows_in = sum((jnp.sum(c.alive.astype(jnp.int64))
+                           for c in childs), start=jnp.int64(0))
+            counts[node.label] = (rows_in,
+                                  jnp.sum(rel.alive.astype(jnp.int64)))
+            rels[id(node)] = rel
+        root = rels[id(plan.root)]
+        return root.table, root.alive, counts, overflow
+
+    def _exec_capped_node(self, node, childs: List[_CappedRel], tables,
+                          schemas, caps):
+        ops = _ops()
+        if isinstance(node, Scan):
+            t = tables[node.source]
+            return _CappedRel(t, jnp.ones((t.num_rows,), bool)), None
+        if isinstance(node, Filter):
+            (c,) = childs
+            # predicate as a mask AND — the jit tier's filter idiom: no
+            # compaction, dead rows stay and stay dead
+            mask = node.predicate.evaluate(c.table, c.alive)
+            return _CappedRel(c.table, c.alive & mask), None
+        if isinstance(node, Project):
+            (c,) = childs
+            return _CappedRel(self._project(c.table, node, c.alive),
+                              c.alive), None
+        if isinstance(node, HashJoin):
+            l, r = childs
+            lkeys = [l.table[k] for k in node.left_keys]
+            rkeys = [r.table[k] for k in node.right_keys]
+            if node.how == "inner":
+                row_cap = self._node_cap(caps, "row_cap", node)
+                lm, rm, valid, ovf = ops.inner_join_capped(
+                    lkeys, rkeys, row_cap=row_cap, lalive=l.alive,
+                    ralive=r.alive)
+                cols = [ops.take(col, lm, _has_negative=False)
+                        for col in l.table.columns]
+                cols += [ops.take(col, rm, _has_negative=False)
+                         for col in r.table.columns]
+                t = Table(cols, names=list(l.table.names) +
+                          list(r.table.names))
+                return _CappedRel(t, valid), ovf
+            mask = ops.semi_join_mask(lkeys, rkeys, lalive=l.alive,
+                                      ralive=r.alive)
+            alive = (l.alive & mask if node.how == "left_semi"
+                     else l.alive & ~mask)
+            return _CappedRel(l.table, alive), None
+        if isinstance(node, HashAggregate):
+            (c,) = childs
+            if not node.keys:
+                t = self._global_aggregate(c.table, node, alive=c.alive)
+                return _CappedRel(t, jnp.ones((1,), bool)), None
+            key_cap = self._node_cap(caps, "key_cap", node)
+            agg, valid, ovf = ops.groupby_aggregate_capped(
+                c.table, list(node.keys), [(cn, o) for cn, o, _ in node.aggs],
+                key_cap=key_cap, alive=c.alive)
+            t = Table(list(agg.columns), names=schemas[id(node)])
+            return _CappedRel(t, valid), ovf
+        if isinstance(node, Sort):
+            (c,) = childs
+            t, alive = ops.sort_table_capped(
+                c.table, key_names=list(node.keys),
+                ascending=list(node.ascending), alive=c.alive)
+            return _CappedRel(t, alive), None
+        if isinstance(node, Limit):
+            (c,) = childs
+            # first n LIVE rows: inclusive prefix count over the mask
+            prefix = jnp.cumsum(c.alive.astype(jnp.int32))
+            return _CappedRel(c.table, c.alive & (prefix <= node.n)), None
+        if isinstance(node, Union):
+            t = ops.concat_tables([c.table for c in childs])
+            alive = jnp.concatenate([c.alive for c in childs])
+            return _CappedRel(t, alive), None
+        if isinstance(node, Exchange):
+            return childs[0], None
+        raise PlanValidationError(f"no capped lowering for {node.kind}")

@@ -1,0 +1,222 @@
+"""Expression mini-language for plan predicates and projections.
+
+The slot Catalyst expressions fill in the reference plugin: `Filter` takes a
+boolean `Expr`, `Project` takes named `Expr`s. Expressions evaluate to raw
+device arrays over one input relation; evaluation is pure jnp, so the same
+expression works in the eager tier (concrete arrays) and inside the capped
+whole-plan jit (tracers).
+
+Scalar-aggregate expressions (`scalar_max(col("rev"))`) evaluate an
+aggregate over the WHOLE input relation and broadcast it — the scalar
+subquery shape q23's `HAVING sum > 0.95 * MAX(...)` needs. In the capped
+tier they reduce only over `alive` rows (the padded-row contract).
+
+Null semantics: expressions read the data buffer only; rows whose inputs
+are null must be dropped by validity-aware operators (the NDS tier is
+null-free). This matches the capped kernels, which also carry validity
+out-of-band.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, FrozenSet, Optional
+
+import jax.numpy as jnp
+
+
+class Expr:
+    """Base expression. Build with `col`/`lit` and python operators."""
+
+    def references(self) -> FrozenSet[str]:
+        raise NotImplementedError
+
+    def evaluate(self, table, alive: Optional[jnp.ndarray] = None):
+        """Array of the expression over `table` ((n,) jnp array; scalar
+        aggregates reduce over `alive` rows when a mask is given)."""
+        raise NotImplementedError
+
+    # ---- operator sugar ---------------------------------------------------
+    def _bin(self, op: str, other) -> "BinOp":
+        return BinOp(op, self, _wrap(other))
+
+    def __eq__(self, other):                       # noqa: D105
+        return self._bin("==", other)
+
+    def __ne__(self, other):
+        return self._bin("!=", other)
+
+    __hash__ = None   # comparison builds expressions; not hashable
+
+    def __lt__(self, other):
+        return self._bin("<", other)
+
+    def __le__(self, other):
+        return self._bin("<=", other)
+
+    def __gt__(self, other):
+        return self._bin(">", other)
+
+    def __ge__(self, other):
+        return self._bin(">=", other)
+
+    def __and__(self, other):
+        return self._bin("&", other)
+
+    def __or__(self, other):
+        return self._bin("|", other)
+
+    def __add__(self, other):
+        return self._bin("+", other)
+
+    def __radd__(self, other):
+        return _wrap(other)._bin("+", self)
+
+    def __sub__(self, other):
+        return self._bin("-", other)
+
+    def __rsub__(self, other):
+        return _wrap(other)._bin("-", self)
+
+    def __mul__(self, other):
+        return self._bin("*", other)
+
+    def __rmul__(self, other):
+        return _wrap(other)._bin("*", self)
+
+    def __invert__(self):
+        return UnaryOp("~", self)
+
+    def __neg__(self):
+        return UnaryOp("-", self)
+
+
+def _wrap(v) -> Expr:
+    return v if isinstance(v, Expr) else Literal(v)
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class ColumnRef(Expr):
+    name: str
+
+    def references(self):
+        return frozenset((self.name,))
+
+    def evaluate(self, table, alive=None):
+        return table[self.name].data
+
+    def __repr__(self):
+        return self.name
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class Literal(Expr):
+    value: Any
+
+    def references(self):
+        return frozenset()
+
+    def evaluate(self, table, alive=None):
+        n = table.num_rows
+        return jnp.full((n,), self.value)
+
+    def __repr__(self):
+        return repr(self.value)
+
+
+_BIN_FNS = {
+    "==": lambda a, b: a == b, "!=": lambda a, b: a != b,
+    "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b, ">=": lambda a, b: a >= b,
+    "&": lambda a, b: a & b, "|": lambda a, b: a | b,
+    "+": lambda a, b: a + b, "-": lambda a, b: a - b,
+    "*": lambda a, b: a * b,
+}
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class BinOp(Expr):
+    op: str
+    left: Expr
+    right: Expr
+
+    def references(self):
+        return self.left.references() | self.right.references()
+
+    def evaluate(self, table, alive=None):
+        return _BIN_FNS[self.op](self.left.evaluate(table, alive),
+                                 self.right.evaluate(table, alive))
+
+    def __repr__(self):
+        return f"({self.left!r} {self.op} {self.right!r})"
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class UnaryOp(Expr):
+    op: str
+    child: Expr
+
+    def references(self):
+        return self.child.references()
+
+    def evaluate(self, table, alive=None):
+        v = self.child.evaluate(table, alive)
+        return ~v if self.op == "~" else -v
+
+    def __repr__(self):
+        return f"{self.op}{self.child!r}"
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class ScalarAgg(Expr):
+    """Aggregate over the whole input relation, broadcast as a scalar —
+    the scalar-subquery shape (q23's `> 0.95 * MAX(rev)`). Honors the
+    capped tier's `alive` mask by reducing over live rows only."""
+    op: str                  # max | min | sum
+    child: Expr
+
+    def references(self):
+        return self.child.references()
+
+    def evaluate(self, table, alive=None):
+        v = self.child.evaluate(table, alive)
+        if alive is not None:
+            ident = _reduce_identity(self.op, v.dtype)
+            v = jnp.where(alive, v, ident)
+        return {"max": jnp.max, "min": jnp.min, "sum": jnp.sum}[self.op](v)
+
+    def __repr__(self):
+        return f"{self.op}({self.child!r})"
+
+
+def _reduce_identity(op: str, dtype):
+    if op == "sum":
+        return jnp.asarray(0, dtype)
+    if jnp.issubdtype(dtype, jnp.floating):
+        inf = jnp.asarray(jnp.inf, dtype)
+        return -inf if op == "max" else inf
+    info = jnp.iinfo(dtype)
+    return jnp.asarray(info.min if op == "max" else info.max, dtype)
+
+
+# ---- public constructors ----------------------------------------------------
+
+def col(name: str) -> ColumnRef:
+    """Reference a column of the input relation by name."""
+    return ColumnRef(name)
+
+
+def lit(value) -> Literal:
+    """A literal, broadcast to the relation's length."""
+    return Literal(value)
+
+
+def scalar_max(e: Expr) -> ScalarAgg:
+    return ScalarAgg("max", _wrap(e))
+
+
+def scalar_min(e: Expr) -> ScalarAgg:
+    return ScalarAgg("min", _wrap(e))
+
+
+def scalar_sum(e: Expr) -> ScalarAgg:
+    return ScalarAgg("sum", _wrap(e))

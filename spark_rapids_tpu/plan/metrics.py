@@ -1,0 +1,56 @@
+"""Per-operator execution metrics — the plugin's GpuMetric slot.
+
+The reference plugin hangs NVTX ranges and task metrics off every exec
+node; here each executed operator records rows/bytes/wall-time and the two
+recovery counters this engine's contracts produce: `retries` (faultinj /
+device-assert recoveries, the RetryOOM analogue) and `escalations` (cap
+growth attempts charged to the node whose capacity overflowed — the
+SplitAndRetry analogue at plan granularity).
+
+`profile()` on a PlanResult returns these rows; the executor additionally
+brackets every operator with `utils.tracing.range_ctx("plan.<label>")`, so
+the same names show up in the xplane/perfetto timeline when
+SPARK_RAPIDS_TPU_TRACE=1.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class OperatorMetrics:
+    label: str                 # node label, e.g. HashJoin#3
+    kind: str                  # node kind, e.g. HashJoin
+    describe: str = ""         # the node's parameter summary
+    rows_in: int = 0           # live input rows (sum over children)
+    rows_out: int = 0          # live output rows
+    bytes_out: int = 0         # output buffer bytes (padded size in capped)
+    wall_ms: Optional[float] = None   # per-op wall (eager tier only)
+    retries: int = 0           # operator re-runs after injected/device faults
+    escalations: int = 0       # cap-growth retries charged to this node
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+def render_profile(rows: List[OperatorMetrics],
+                   plan_wall_ms: Optional[float] = None,
+                   attempts: int = 1,
+                   caps: Optional[Dict] = None) -> str:
+    """Human-readable profile table (the `profile()` text form)."""
+    out = []
+    if plan_wall_ms is not None:
+        caps_s = f" caps={caps}" if caps else ""
+        out.append(f"plan: {plan_wall_ms:.3f} ms, "
+                   f"{attempts} attempt(s){caps_s}")
+    hdr = (f"{'operator':<28} {'rows_in':>10} {'rows_out':>10} "
+           f"{'bytes_out':>12} {'wall_ms':>9} {'retry':>5} {'escal':>5}")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for m in rows:
+        wall = f"{m.wall_ms:.3f}" if m.wall_ms is not None else "-"
+        out.append(f"{m.label:<28} {m.rows_in:>10} {m.rows_out:>10} "
+                   f"{m.bytes_out:>12} {wall:>9} {m.retries:>5} "
+                   f"{m.escalations:>5}")
+    return "\n".join(out)

@@ -27,7 +27,13 @@ import jax  # noqa: E402
 
 if not TPU_SMOKE:
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # older jax (< 0.5) has no jax_num_cpu_devices; the
+        # XLA_FLAGS=--xla_force_host_platform_device_count=8 set above covers
+        # it as long as the backend is not initialized yet
+        pass
 
 # ---------------------------------------------------------------------------
 # tpu_smoke tier: one config per op family, runnable on the real chip.

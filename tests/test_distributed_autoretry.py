@@ -16,6 +16,13 @@ from spark_rapids_tpu.parallel import (CapacityOverflowError,
                                        distributed_inner_join_auto,
                                        distributed_sort_auto, make_mesh)
 
+# Every test here traces a whole shard_map SPMD program — minutes of
+# jax tracing that no persistent compilation cache can skip — so the
+# module is `slow`: excluded from the timed tier-1 verify, still run
+# by ci/premerge.sh and ci/nightly.sh.
+pytestmark = pytest.mark.slow
+
+
 NDEV = 8
 
 

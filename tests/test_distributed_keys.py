@@ -22,6 +22,13 @@ from spark_rapids_tpu.parallel import (decode_key_columns,
                                        encode_key_columns, make_mesh,
                                        spark_partition_hash)
 
+# Every test here traces a whole shard_map SPMD program — minutes of
+# jax tracing that no persistent compilation cache can skip — so the
+# module is `slow`: excluded from the timed tier-1 verify, still run
+# by ci/premerge.sh and ci/nightly.sh.
+pytestmark = pytest.mark.slow
+
+
 NDEV = 8
 
 

@@ -14,6 +14,13 @@ from spark_rapids_tpu.ops import groupby_aggregate, inner_join
 from spark_rapids_tpu.parallel import (distributed_groupby,
                                        distributed_inner_join, make_mesh)
 
+# Every test here traces a whole shard_map SPMD program — minutes of
+# jax tracing that no persistent compilation cache can skip — so the
+# module is `slow`: excluded from the timed tier-1 verify, still run
+# by ci/premerge.sh and ci/nightly.sh.
+pytestmark = pytest.mark.slow
+
+
 NDEV = 8
 
 

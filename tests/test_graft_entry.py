@@ -29,6 +29,8 @@ def test_entry_compiles_and_runs():
 @pytest.mark.nightly  # the driver runs dryrun_multichip(8) itself every
 # round (MULTICHIP check) — in the default tier this multi-minute SPMD
 # trace would duplicate that external gate on the single-core box
+@pytest.mark.slow     # and the timed tier-1 verify excludes it for the
+# same reason (its -m 'not slow' supersedes the addopts 'not nightly')
 def test_dryrun_multichip_eight():
     import __graft_entry__ as g
     g.dryrun_multichip(8)

@@ -1,0 +1,136 @@
+"""The two groupby kernel designs (scan vs scatter/segment — see
+ops/aggregate.py) must be interchangeable: same results over every agg op,
+null layout, and the capped/alive contract. The suite's CPU backend runs
+the scatter kernel by default (backend dispatch), so this file pins each
+kernel explicitly and A/Bs them on the same data."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import spark_rapids_tpu  # noqa: F401
+from spark_rapids_tpu import Column, Table, dtypes
+from spark_rapids_tpu.ops import groupby_aggregate, groupby_aggregate_capped
+from spark_rapids_tpu.ops.aggregate import _use_scan_kernel
+
+
+@pytest.fixture(params=["scan", "scatter"])
+def kernel(request, monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_GROUPBY_KERNEL", request.param)
+    return request.param
+
+
+def _table(n=5000, seed=0, with_nulls=True):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, 60, n).astype(np.int64)
+    ints = rng.integers(-1000, 1000, n).astype(np.int64)
+    floats = rng.standard_normal(n)
+    floats[rng.random(n) < 0.02] = np.nan
+    valid = rng.random(n) > 0.15 if with_nulls else None
+    cols = [Column.from_numpy(keys),
+            Column.from_numpy(ints, validity=valid),
+            Column.from_numpy(floats, validity=valid)]
+    return Table(cols, names=["k", "i", "f"]), keys, ints, floats, valid
+
+
+AGGS = [("i", "sum"), ("i", "count"), ("i", "min"), ("i", "max"),
+        ("f", "sum"), ("f", "mean"), ("f", "min"), ("f", "max"),
+        ("i", "size")]
+
+
+def _ref(keys, ints, floats, valid):
+    import pandas as pd
+    df = pd.DataFrame({"k": keys,
+                       "i": pd.array(ints).astype("Int64"),
+                       "f": floats})
+    if valid is not None:
+        df.loc[~valid, "i"] = pd.NA
+        df.loc[~valid, "f"] = np.nan
+    return df
+
+
+def test_kernels_agree_all_ops(monkeypatch):
+    t, *_ = _table()
+    results = {}
+    for k in ("scan", "scatter"):
+        monkeypatch.setenv("SPARK_RAPIDS_TPU_GROUPBY_KERNEL", k)
+        out = groupby_aggregate(t, ["k"], AGGS)
+        results[k] = [c.to_pylist() for c in out]
+    a, b = results["scan"], results["scatter"]
+    assert len(a) == len(b)
+    for ca, cb in zip(a, b):
+        assert len(ca) == len(cb)
+        for va, vb in zip(ca, cb):
+            if va is None or vb is None:
+                assert va == vb
+            elif isinstance(va, float):
+                assert (np.isnan(va) and np.isnan(vb)) or \
+                    va == pytest.approx(vb, rel=1e-12)
+            else:
+                assert va == vb
+
+
+def test_scatter_kernel_matches_pandas(monkeypatch):
+    """Direct oracle for the scatter kernel (the scan kernel's oracle
+    coverage lives in test_relational.py)."""
+    import pandas as pd
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_GROUPBY_KERNEL", "scatter")
+    t, keys, ints, floats, valid = _table(seed=4)
+    out = groupby_aggregate(t, ["k"], [("i", "sum"), ("i", "count"),
+                                       ("f", "mean"), ("i", "max")])
+    g = _ref(keys, ints, floats, valid).groupby("k")
+    ref_sum = g["i"].sum(min_count=1)
+    ref_cnt = g["i"].count()
+    ref_max = g["i"].max()
+    got_k = out[0].to_pylist()
+    assert got_k == sorted(set(keys.tolist()))
+    ok = valid if valid is not None else np.ones(len(keys), bool)
+    for gk, s, c, m, mx in zip(got_k, out[1].to_pylist(),
+                               out[2].to_pylist(), out[3].to_pylist(),
+                               out[4].to_pylist()):
+        assert c == int(ref_cnt[gk])
+        assert s == (None if pd.isna(ref_sum[gk]) else int(ref_sum[gk]))
+        # mean skips NULLS but propagates NaN VALUES (Spark double
+        # addition) — pandas mean skips both, so oracle it by hand
+        vals = floats[(keys == gk) & ok]
+        if len(vals) == 0:
+            assert m is None
+        elif np.isnan(vals.sum()):
+            assert np.isnan(m)
+        else:
+            assert m == pytest.approx(vals.sum() / len(vals), rel=1e-12)
+        assert mx == (None if pd.isna(ref_max[gk]) else int(ref_max[gk]))
+
+
+def test_capped_alive_contract_both_kernels(kernel):
+    """The capped/alive padded-row contract holds on either kernel."""
+    t, keys, ints, _, valid = _table(n=2000, seed=2)
+    alive = jnp.asarray(np.arange(2000) % 4 != 0)
+    out, gvalid, overflow = groupby_aggregate_capped(
+        t, ["k"], [("i", "sum")], key_cap=128, alive=alive)
+    assert not bool(overflow)
+    m = np.asarray(gvalid)
+    got = dict(zip(np.asarray(out["k"].data)[m].tolist(),
+                   np.asarray(out["sum(i)"].data)[m].tolist()))
+    a = np.asarray(alive)
+    ref = {}
+    for k in sorted(set(keys[a].tolist())):
+        sel = a & (keys == k) & (valid if valid is not None else True)
+        ref[k] = int(ints[sel].sum())
+    assert set(got) == set(ref)
+    for k in ref:
+        sel = a & (keys == k) & (valid if valid is not None else True)
+        if sel.any():
+            assert got[k] == ref[k], k
+
+
+def test_dispatch_default_is_scatter_on_cpu(monkeypatch):
+    monkeypatch.delenv("SPARK_RAPIDS_TPU_GROUPBY_KERNEL", raising=False)
+    import jax
+    if jax.default_backend() == "cpu":
+        assert not _use_scan_kernel()
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_GROUPBY_KERNEL", "scan")
+    assert _use_scan_kernel()
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_GROUPBY_KERNEL", "bogus")
+    with pytest.raises(ValueError, match="bogus"):
+        _use_scan_kernel()

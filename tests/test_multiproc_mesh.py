@@ -31,6 +31,14 @@ def _run(procs: str, local: str):
 def _assert_ok(r, n_procs: int):
     ok = [ln for ln in r.stdout.splitlines()
           if ln.startswith("MULTIPROC MESH OK")]
+    if "Multiprocess computations aren't implemented on the CPU backend" \
+            in (r.stdout + r.stderr):
+        # infrastructure, not a product failure: this jaxlib's CPU client
+        # has no cross-process collectives (newer jaxlibs ship the gloo
+        # backend) — the same tolerance tier as ci/tpu-smoke.sh's dead
+        # tunnel. The path still runs wherever the suite has a capable
+        # jaxlib or real chips.
+        pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
     assert r.returncode == 0, (r.stdout[-800:], r.stderr[-800:])
     assert len(ok) == n_procs, r.stdout[-800:]
 

@@ -697,3 +697,23 @@ def test_full_join_matches_multiset_oracle():
                        key=lambda t: (t[0] is None, t[0] or 0,
                                       t[1] is None, t[1] or 0))
     assert got_pairs == want
+
+
+def test_capped_join_x64_guard():
+    """The capped joins' int64 match-count overflow guard must not silently
+    degrade to int32 when a host app flips jax_enable_x64 off (round-5
+    ADVICE): they fail loudly at use instead."""
+    import jax
+    from spark_rapids_tpu.ops import inner_join_capped, left_join_capped
+    l, r = col([1, 2, 3], np.int32), col([2, 3, 4], np.int32)
+    jax.config.update("jax_enable_x64", False)
+    try:
+        with pytest.raises(RuntimeError, match="x64"):
+            inner_join_capped([l], [r], row_cap=8)
+        with pytest.raises(RuntimeError, match="x64"):
+            left_join_capped([l], [r], row_cap=8)
+    finally:
+        jax.config.update("jax_enable_x64", True)
+    # with the flag restored the op works
+    lm, rm, valid, overflow = inner_join_capped([l], [r], row_cap=8)
+    assert int(np.asarray(valid).sum()) == 2 and not bool(overflow)
