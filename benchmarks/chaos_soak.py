@@ -1,0 +1,115 @@
+"""Chaos soak: the NDS plan pipelines under a seeded fault-injection config.
+
+The nightly robustness gate (ci/nightly.sh): run q5 and q3 through the plan
+engine while `configs/chaos_soak.json` injects a mix of nonfatal faults
+(device asserts on joins/aggregates, a substituted return code on projects)
+plus ONE fatal fault armed on the first `plan.Sort` interception — and
+assert the production recovery story end to end:
+
+1. q5 absorbs the nonfatal faults as backoff-paced retries, then hits the
+   fatal at its final Sort: the breaker trips and the plan COMPLETES on the
+   degraded CPU tier with result parity against the fault-free run.
+2. q3 starts with the breaker open (device quarantined, still poisoned):
+   it runs fully degraded without touching the device — parity again.
+3. `reset_device()` arms the half-open probation; the heartbeat probe
+   closes the breaker and q3 re-runs on the normal path — parity again.
+
+Every run emits a bench JSONL row with the robustness fields (`retries`,
+`faults_injected`, `degraded` — benchmarks/common.py emit_record), so the
+nightly log shows how much chaos the engine actually absorbed. The soak
+FAILS (non-zero exit) on any parity miss, on zero injected faults, zero
+retries, or zero degraded completions — a silently-ineffective fault config
+must not pass as green.
+"""
+import os
+import sys
+import time
+
+# keep retry pacing out of the nightly wall-clock (config reads at use time)
+os.environ.setdefault("SPARK_RAPIDS_TPU_BREAKER_BACKOFF_BASE_MS", "1")
+os.environ.setdefault("SPARK_RAPIDS_TPU_BREAKER_BACKOFF_MAX_MS", "8")
+
+sys.path.insert(0, ".")
+
+from benchmarks.common import emit_record, parse_args  # noqa: E402
+
+CONFIG = os.path.join(os.path.dirname(__file__), os.pardir, "configs",
+                      "chaos_soak.json")
+
+
+def _run(ex, plan, inputs):
+    t0 = time.perf_counter()
+    res = ex.execute(plan, inputs)
+    return res, (time.perf_counter() - t0) * 1e3
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    from spark_rapids_tpu import faultinj
+    from spark_rapids_tpu.plan import PlanExecutor
+    from spark_rapids_tpu.runtime.health import HALF_OPEN
+    from benchmarks.bench_nds_q3 import build_tables as q3_tables
+    from benchmarks.bench_nds_q5 import build_tables as q5_tables
+    from benchmarks.nds_plans import (q3_inputs, q3_plan, q5_inputs, q5_plan)
+
+    n = max(2000, int(30_000 * args.scale))
+    sales, dates3, items = q3_tables(n, seed=7)
+    tabs, dates5 = q5_tables(n, seed=3)
+    plans = {"q5": (q5_plan(), q5_inputs(tabs, dates5)),
+             "q3": (q3_plan(), q3_inputs(sales, dates3, items))}
+
+    # fault-free references (and compile warm-up) before the injector loads
+    ex = PlanExecutor(mode="eager")
+    refs = {q: ex.execute(p, i).table.to_pydict()
+            for q, (p, i) in plans.items()}
+
+    inj = faultinj.install(CONFIG)
+    totals = {"retries": 0, "faults": 0, "degraded": 0}
+    try:
+        def soak(q, expect_degraded=None):
+            plan, inputs = plans[q]
+            res, ms = _run(ex, plan, inputs)
+            faults = inj.get_and_reset_injected()
+            if res.table.to_pydict() != refs[q]:
+                raise SystemExit(f"chaos soak: {q} parity MISS "
+                                 f"(degraded={res.degraded})")
+            if expect_degraded is not None and res.degraded != expect_degraded:
+                raise SystemExit(f"chaos soak: {q} degraded={res.degraded}, "
+                                 f"expected {expect_degraded} "
+                                 f"(breaker {res.breaker})")
+            totals["retries"] += res.retries
+            totals["faults"] += faults
+            totals["degraded"] += int(res.degraded)
+            emit_record("chaos_soak", {"query": q, "rows": n}, ms, n,
+                        impl="plan_eager", retries=res.retries,
+                        faults_injected=faults, degraded=res.degraded,
+                        breaker=res.breaker["state"])
+            return res
+
+        # 1. nonfatal storm + the one fatal (first plan.Sort): degrades
+        soak("q5", expect_degraded=True)
+        # 2. breaker open, device poisoned: full plans stay on the CPU tier
+        soak("q3", expect_degraded=True)
+        # 3. operator intervention: reset + half-open probe -> normal tier
+        ex.health.reset_device()
+        assert ex.health.breaker.state == HALF_OPEN
+        res = soak("q3", expect_degraded=False)
+        if res.breaker["state"] != "closed":
+            raise SystemExit(f"chaos soak: breaker failed to close after "
+                             f"reset_device ({res.breaker})")
+    finally:
+        faultinj.uninstall()
+
+    health = ex.health.get_and_reset_metrics()
+    if totals["faults"] == 0 or totals["retries"] == 0 \
+            or totals["degraded"] == 0:
+        raise SystemExit(f"chaos soak ineffective: {totals} (health "
+                         f"counters {health}) — fault config injected "
+                         "nothing worth recovering from")
+    print(f"chaos soak OK: {totals['faults']} faults injected, "
+          f"{totals['retries']} retries, {totals['degraded']} degraded "
+          f"completions, breaker closed")
+
+
+if __name__ == "__main__":
+    main()

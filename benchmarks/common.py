@@ -109,6 +109,32 @@ def steady_state_ms(fn: Callable, args, iters: int, platform: str) -> float:
     return t2 / (2 * last_iters)
 
 
+def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
+                impl: str = None, retries: int = None,
+                faults_injected: int = None, degraded: bool = None,
+                **extra) -> Dict:
+    """Build + print one bench JSONL record.
+
+    Optional robustness fields (the chaos-soak stage records these, see
+    benchmarks/chaos_soak.py / docs/robustness.md): `retries` (fault
+    re-runs the plan survived), `faults_injected` (faultinj count drained
+    via get_and_reset_injected), `degraded` (result produced by the CPU
+    fallback tier after a breaker trip)."""
+    rec = {"bench": bench, "axes": axes, "ms": round(ms, 3),
+           "rows_per_s": round(n_rows / (ms * 1e-3))}
+    if impl is not None:
+        rec["impl"] = impl
+    if retries is not None:
+        rec["retries"] = retries
+    if faults_injected is not None:
+        rec["faults_injected"] = faults_injected
+    if degraded is not None:
+        rec["degraded"] = degraded
+    rec.update(extra)
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 def run_config(bench: str, axes: Dict, fn: Callable, args, *, n_rows: int,
                iters: int = 10, jit: bool = True,
                impl: str = None) -> Dict:
@@ -128,15 +154,11 @@ def run_config(bench: str, axes: Dict, fn: Callable, args, *, n_rows: int,
     out = fn(*args)
     sync(out)                           # compile + warmup
     ms = steady_state_ms(fn, args, iters, jax.default_backend())
-    rec = {"bench": bench, "axes": axes, "ms": round(ms, 3),
-           "rows_per_s": round(n_rows / (ms * 1e-3))}
-    if impl is not None:
-        rec["impl"] = impl
+    extra = {}
     if getattr(steady_state_ms, "last_upper_bound", False):
-        rec["ms_upper_bound"] = True    # sync round-trip folded in; see
+        extra["ms_upper_bound"] = True  # sync round-trip folded in; see
         # steady_state_ms noise-floor fallback
-    print(json.dumps(rec), flush=True)
-    return rec
+    return emit_record(bench, axes, ms, n_rows, impl=impl, **extra)
 
 
 # ---- datagen ----------------------------------------------------------------

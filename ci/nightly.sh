@@ -18,6 +18,11 @@ PY
 
 python -m pytest tests/ -q -m ""    # include the nightly-marked tier
 python benchmarks/run_all.py --scale 0.01 --iters 5 --cpu
+# chaos soak (docs/robustness.md): NDS plans under a seeded faultinj config
+# (mixed nonfatal + one fatal) — asserts result parity with the fault-free
+# run, non-zero retry/degraded counts, and breaker recovery via
+# reset_device(); emits retries/faults_injected/degraded JSONL fields
+JAX_PLATFORMS=cpu python benchmarks/chaos_soak.py --scale 0.2 --cpu
 ./ci/fuzz-test.sh
 ./ci/sanitizer.sh
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

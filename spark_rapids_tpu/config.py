@@ -14,6 +14,18 @@ equivalents, all read at use time (not import time) so tests can monkeypatch:
 | TPU_FAULT_INJECTOR_CONFIG_PATH   | —    | fault injector config (faultinj)|
 | SPARK_RAPIDS_TPU_ROW_CONVERSION_KERNEL | auto | auto/word/concat (ops/row_conversion) |
 | SPARK_RAPIDS_TPU_GROUPBY_KERNEL  | auto | auto/scan/scatter (ops/aggregate) |
+| SPARK_RAPIDS_TPU_BREAKER_RETRY_BUDGET | 16 | fault retries allowed per plan attempt (runtime/health) |
+| SPARK_RAPIDS_TPU_BREAKER_BACKOFF_BASE_MS | 10 | first-retry backoff (doubles per attempt, jittered) |
+| SPARK_RAPIDS_TPU_BREAKER_BACKOFF_MAX_MS | 1000 | backoff ceiling |
+| SPARK_RAPIDS_TPU_BREAKER_STICKY_THRESHOLD | 3 | same-op failures within the window that classify as sticky |
+| SPARK_RAPIDS_TPU_BREAKER_STICKY_WINDOW_S | 60 | sticky-detection window |
+| SPARK_RAPIDS_TPU_BREAKER_COOLDOWN_S | 30 | open→half_open self-arm delay (0 = only reset_device) |
+| SPARK_RAPIDS_TPU_BREAKER_DEGRADE | cpu  | cpu (finish tripped plans on the CPU tier) / off |
+
+The SPARK_RAPIDS_TPU_BREAKER_* numeric knobs are snapshotted when a
+`DeviceHealthMonitor` is constructed (one policy per monitor lifetime —
+construct a new monitor/executor, or pass constructor overrides, to
+re-tune); everything else in the table is read at use time.
 """
 from __future__ import annotations
 
@@ -23,6 +35,13 @@ import os
 def _int_env(name: str, default: int) -> int:
     try:
         return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _float_env(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
     except ValueError:
         return default
 
@@ -53,6 +72,52 @@ def row_conversion_kernel() -> str:
         raise ValueError(
             f"SPARK_RAPIDS_TPU_ROW_CONVERSION_KERNEL={v!r}: expected "
             "auto, word, or concat")
+    return v
+
+
+def breaker_retry_budget() -> int:
+    """Fault retries allowed per plan attempt, shared across every operator
+    in the plan (runtime/health.py) — the no-retry-storm bound."""
+    return _int_env("SPARK_RAPIDS_TPU_BREAKER_RETRY_BUDGET", 16)
+
+
+def breaker_backoff_base_ms() -> float:
+    """Backoff before the first retry; doubles per attempt with jitter.
+    Float-valued: sub-millisecond pacing (e.g. 0.5) is valid."""
+    return _float_env("SPARK_RAPIDS_TPU_BREAKER_BACKOFF_BASE_MS", 10.0)
+
+
+def breaker_backoff_max_ms() -> float:
+    return _float_env("SPARK_RAPIDS_TPU_BREAKER_BACKOFF_MAX_MS", 1000.0)
+
+
+def breaker_sticky_threshold() -> int:
+    """Failures of the SAME operator within the sticky window that escalate
+    the classification from transient to sticky (breaker trip)."""
+    return _int_env("SPARK_RAPIDS_TPU_BREAKER_STICKY_THRESHOLD", 3)
+
+
+def breaker_sticky_window_s() -> float:
+    return _float_env("SPARK_RAPIDS_TPU_BREAKER_STICKY_WINDOW_S", 60.0)
+
+
+def breaker_cooldown_s() -> float:
+    """Seconds an OPEN breaker waits before self-arming HALF_OPEN (the
+    next admission then probes the device). Keeps quarantine from being
+    permanent when the trip cause was transient (a pressure burst, a
+    since-recovered device); 0 disables — only reset_device() re-arms."""
+    return _float_env("SPARK_RAPIDS_TPU_BREAKER_COOLDOWN_S", 30.0)
+
+
+def breaker_degrade() -> str:
+    """Degradation policy when the breaker trips: "cpu" finishes the plan on
+    the CPU backend tier, "off" propagates the failure (legacy behavior).
+    Same strict-typo policy as the kernel selectors: a typo must not
+    silently change failure-domain behavior."""
+    v = os.environ.get("SPARK_RAPIDS_TPU_BREAKER_DEGRADE", "cpu")
+    if v not in ("cpu", "off"):
+        raise ValueError(
+            f"SPARK_RAPIDS_TPU_BREAKER_DEGRADE={v!r}: expected cpu or off")
     return v
 
 

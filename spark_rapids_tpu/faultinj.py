@@ -97,6 +97,7 @@ class FaultInjector:
         self._mtime = 0.0
         self._lock = threading.Lock()
         self._device_poisoned = False
+        self._injected = 0
         self._load()
 
     # ---- config ------------------------------------------------------------
@@ -142,9 +143,19 @@ class FaultInjector:
     def device_poisoned(self) -> bool:
         return self._device_poisoned
 
+    def get_and_reset_injected(self) -> int:
+        """Faults fired since the last drain (arbiter-style get-and-reset;
+        the chaos-soak stage records this per benchmark run)."""
+        with self._lock:
+            n = self._injected
+            self._injected = 0
+        return n
+
     def on_call(self, api_name: str, which: str) -> None:
         """Interception callback — the CUPTI callback-handler analogue
         (faultinj.cu:158-260). Raises when a fault fires."""
+        if getattr(_suppress, "on", False):
+            return      # degraded CPU tier: no device, no device faults
         self._maybe_reload()
         if self._device_poisoned:
             raise DeviceFatalError(
@@ -155,6 +166,8 @@ class FaultInjector:
         if rule is None or not rule.draw(self.rng):
             return
         log.debug("injecting fault type %d into %s", rule.injection_type, api_name)
+        with self._lock:
+            self._injected += 1
         if rule.injection_type == FAULT_FATAL:
             self._device_poisoned = True
             raise DeviceFatalError(f"injected fatal device fault in {api_name}")
@@ -168,6 +181,30 @@ class FaultInjector:
 
     def on_runtime(self, api_name: str) -> None:
         self.on_call(api_name, "runtime_rules")
+
+
+# ---- thread-local suppression ----------------------------------------------
+
+_suppress = threading.local()
+
+
+class suppressed:
+    """Context manager: disable interception on this thread.
+
+    The degraded CPU tier (plan/executor.py, docs/robustness.md) runs
+    device-free, so NO device-call interception — compute shims, the
+    arbiter-fronted MemoryBudget shims, or a poisoned-device fail-fast —
+    may fire inside it; a dead device must not be able to kill the
+    fallback that exists to survive it."""
+
+    def __enter__(self):
+        self._prev = getattr(_suppress, "on", False)
+        _suppress.on = True
+        return self
+
+    def __exit__(self, *exc):
+        _suppress.on = self._prev
+        return False
 
 
 # ---- global install / uninstall --------------------------------------------

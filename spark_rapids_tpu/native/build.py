@@ -28,6 +28,11 @@ _LDFLAGS = {
     "parquet_reader": ["-lz", "-l:libzstd.so.1", "-l:libsnappy.so.1"],
 }
 
+# one flag list for build() AND check_warnings(): the nightly warning gate
+# must compile exactly what ships or its diagnostics are for different code
+_BASE_CMD = ["g++", "-std=c++17", "-O2", "-g", "-fPIC", "-shared",
+             "-pthread", "-Wall", "-Wextra"]
+
 
 def lib_path(name: str) -> str:
     return os.path.join(_HERE, f"lib{name}.so")
@@ -45,9 +50,8 @@ def check_warnings() -> list:
     out = []
     with tempfile.TemporaryDirectory() as tmp:
         for name, srcs in _SOURCES.items():
-            cmd = ["g++", "-std=c++17", "-O2", "-g", "-fPIC", "-shared",
-                   "-pthread", "-Wall", "-Wextra",
-                   "-o", os.path.join(tmp, f"lib{name}.so")] + \
+            cmd = _BASE_CMD + \
+                ["-o", os.path.join(tmp, f"lib{name}.so")] + \
                 [os.path.join(_HERE, s) for s in srcs] + \
                 _LDFLAGS.get(name, [])
             proc = subprocess.run(cmd, capture_output=True, text=True)
@@ -71,8 +75,7 @@ def build(name: str) -> str:
         if os.path.exists(out) and all(
                 os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs):
             return out
-        cmd = ["g++", "-std=c++17", "-O2", "-g", "-fPIC", "-shared", "-pthread",
-               "-Wall", "-Wextra", "-o", out] + srcs + _LDFLAGS.get(name, [])
+        cmd = _BASE_CMD + ["-o", out] + srcs + _LDFLAGS.get(name, [])
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(

@@ -19,7 +19,10 @@ concerns live in ONE executor:
   or traces the whole plan into ONE capped XLA program (jit tier) with
   geometric cap escalation via `parallel.autoretry` at plan granularity;
   admission (`runtime.admission`), `faultinj` interception and
-  `utils.tracing` ranges apply per operator.
+  `utils.tracing` ranges apply per operator. Device failures resolve
+  through the `runtime.health` degradation policy — backoff-paced retries
+  for transient faults, circuit-breaker trip + degraded CPU-tier
+  completion for sticky/fatal ones (docs/robustness.md).
 - `metrics`: `explain()` (pre-run plan tree) and `profile()` (post-run
   per-operator rows/bytes/wall-time/retry counts).
 

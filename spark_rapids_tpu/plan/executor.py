@@ -3,15 +3,16 @@
 - `mode="eager"`: per-operator dispatch through the public `ops` kernels —
   every operator gets its own wall-clock, rows/bytes metrics, a
   `utils.tracing` range, a plan-level faultinj interception point, and a
-  bounded re-run on recoverable injected faults (the plan-level retry that
-  replaces per-query hand-wiring).
+  bounded, backoff-paced re-run on recoverable injected faults (the
+  plan-level retry that replaces per-query hand-wiring).
 - `mode="capped"`: the whole DAG traces into ONE XLA program with static
   capacities (`row_cap` for joins, `key_cap` for aggregates — per-node
   overrides take precedence). A too-small cap raises the overflow flag and
   `parallel.autoretry.auto_retry_overflow` grows every cap geometrically
   and re-traces — SplitAndRetry at PLAN granularity, not per-call. The
-  compiled program is cached per (plan, caps), so escalated caps are
-  remembered for the rest of the job.
+  compiled program is cached per (plan, caps, input shapes) and the final
+  capacities are memoized per plan, so escalated caps are remembered for
+  the rest of the job (later execute() calls start from the grown caps).
 - distributed (eager tier only — the constructor rejects a mesh with
   mode="capped"): when a device `mesh` is given, a `HashAggregate` sitting
   on an `Exchange` runs on the `parallel.relational` tier (partial agg →
@@ -23,6 +24,19 @@ executor calls the public `ops` surface through module attribute lookup, so
 the admission wrappers — and any installed faultinj shims — intercept every
 kernel the plan dispatches. Pass `session=` to scope a DeviceSession to the
 execution without touching process-global state.
+
+Failure handling is a *policy*, owned by `runtime.health` (docs/
+robustness.md): transient faults (injected nonfatal asserts, substituted
+return codes, RetryOOM spikes) retry with jittered exponential backoff
+against a per-plan-attempt retry budget; sticky (same op keeps failing) and
+fatal (`DeviceFatalError`) failures trip the circuit breaker and — with the
+default `degrade="cpu"` — the remaining plan re-executes on the CPU backend
+tier, salvaging completed operator outputs through host memory. `explain()`
+is unchanged; `profile()`/`PlanResult` record `degraded`, `backoff_ms`, and
+the breaker snapshot so a degraded run is visible after the fact. While the
+breaker is open the device is quarantined (plans run fully degraded);
+`health.reset_device()` arms a half-open probation and a cheap heartbeat
+probe op decides whether normal execution resumes.
 
 Results carry `profile()` — per-operator rows (live rows in the capped
 tier, computed on-device and returned with the result), output buffer
@@ -46,18 +60,82 @@ from .nodes import (Exchange, Filter, HashAggregate, HashJoin, Limit,
                     Union)
 from .expr import ColumnRef
 
-# Recoverable fault types (injected nonfatal device assert / substituted
-# return code). DeviceFatalError deliberately propagates: a dead device
-# must stop the retry loop, that is the whole point of the fatal tier.
-def _recoverable_faults():
+# The device-fault surface the executor turns into policy (runtime/health):
+# injected nonfatal asserts and substituted return codes plus RetryOOM
+# pressure spikes classify transient (jittered backoff + budgeted retry);
+# DeviceFatalError classifies fatal and is NEVER retried on the device —
+# a dead device must stop the retry loop, that is the whole point of the
+# fatal tier. Sticky/fatal failures trip the breaker; with degrade="cpu"
+# the remaining plan re-executes on the CPU backend tier.
+def _fault_surface():
     from .. import faultinj
-    return (faultinj.DeviceAssertError, faultinj.InjectedReturnCode)
+    from ..runtime.adaptor import CpuRetryOOM, RetryOOM
+    return (faultinj.DeviceFatalError, faultinj.DeviceAssertError,
+            faultinj.InjectedReturnCode, RetryOOM, CpuRetryOOM)
 
 
 def _ops():
     # attribute lookups on the module keep admission + faultinj shims live
     from .. import ops
     return ops
+
+
+class _LruDict(dict):
+    """Bounded cache: lookups refresh recency, inserts evict the oldest.
+    Executors live for a whole job while front-ends may hand them a fresh
+    Plan per query — unbounded program/caps caches would pin every plan's
+    node graph forever."""
+
+    def __init__(self, maxsize: int):
+        super().__init__()
+        self.maxsize = maxsize
+
+    def get(self, key, default=None):
+        if key in self:
+            val = super().pop(key)
+            super().__setitem__(key, val)   # re-insert = most recent
+            return val
+        return default
+
+    def __setitem__(self, key, value):
+        super().pop(key, None)
+        super().__setitem__(key, value)
+        while len(self) > self.maxsize:
+            del self[next(iter(self))]
+
+
+def _cpu_device():
+    try:
+        return jax.devices("cpu")[0]
+    except Exception:
+        return None
+
+
+def _table_to_cpu(t: Table, dev) -> Table:
+    """Salvage a table onto the CPU backend through host memory (the
+    degraded tier's handoff for results computed before the breaker
+    tripped)."""
+    import dataclasses
+
+    def put(a):
+        if a is None:
+            return None
+        try:
+            if a.devices() == {dev}:
+                return a            # already home: no host round-trip
+        except Exception:
+            pass
+        return jax.device_put(np.asarray(a), dev)
+
+    def col_cpu(c: Column) -> Column:
+        return dataclasses.replace(
+            c, data=put(c.data), validity=put(c.validity),
+            offsets=put(c.offsets),
+            children=type(c.children)(col_cpu(k) for k in c.children))
+
+    if dev is None:
+        return t
+    return Table([col_cpu(c) for c in t.columns], names=list(t.names))
 
 
 def _np_dtype_to_dt(np_dt) -> dtypes.DType:
@@ -89,7 +167,10 @@ class PlanResult:
                  valid: Optional[jnp.ndarray],
                  metrics: Dict[str, OperatorMetrics],
                  mode: str, wall_ms: float, attempts: int = 1,
-                 caps: Optional[Dict[str, int]] = None, retries: int = 0):
+                 caps: Optional[Dict[str, int]] = None, retries: int = 0,
+                 degraded: bool = False,
+                 breaker: Optional[Dict] = None,
+                 backoff_ms: float = 0.0):
         self.plan = plan
         self.table = table
         self.valid = valid
@@ -99,6 +180,9 @@ class PlanResult:
         self.attempts = attempts      # capped-tier cap-escalation attempts
         self.caps = caps              # final (possibly grown) capacities
         self.retries = retries        # plan-level recoverable-fault re-runs
+        self.degraded = degraded      # finished on the CPU tier (breaker trip)
+        self.breaker = breaker        # {"state","trips","reason","error"}
+        self.backoff_ms = backoff_ms  # total retry backoff across the plan
 
     def compact(self) -> Table:
         """Live rows only (identity in the eager tier)."""
@@ -115,7 +199,8 @@ class PlanResult:
     def profile_text(self) -> str:
         return render_profile(list(self.metrics.values()),
                               plan_wall_ms=self.wall_ms,
-                              attempts=self.attempts, caps=self.caps)
+                              attempts=self.attempts, caps=self.caps,
+                              degraded=self.degraded, breaker=self.breaker)
 
 
 class _CappedRel:
@@ -138,13 +223,17 @@ class PlanExecutor:
                  op_retries: int = 2,
                  mesh=None, mesh_axis: str = "data",
                  session=None,
-                 block_per_op: bool = True):
+                 block_per_op: bool = True,
+                 health=None,
+                 degrade: Optional[str] = None):
         if mode not in ("eager", "capped"):
             raise ValueError(f"unknown executor mode {mode!r}")
         if mesh is not None and mode != "eager":
             raise ValueError(
                 "distributed lowering (mesh=) exists only in the eager tier "
                 "for now; a capped executor would silently ignore the mesh")
+        from .. import config
+        from ..runtime.health import DeviceHealthMonitor
         self.mode = mode
         self.caps = dict(caps or {})
         self.max_cap_attempts = max_cap_attempts
@@ -153,7 +242,19 @@ class PlanExecutor:
         self.mesh_axis = mesh_axis
         self.session = session
         self.block_per_op = block_per_op
-        self._jit_cache: Dict[Tuple, Tuple[Callable, Dict, Dict]] = {}
+        # health: the degradation policy owner (runtime/health.py). Pass a
+        # shared monitor to give several executors one breaker per device.
+        self.health = health if health is not None else DeviceHealthMonitor()
+        self.degrade = degrade if degrade is not None else config.breaker_degrade()
+        if self.degrade not in ("cpu", "off"):
+            raise ValueError(f"unknown degrade policy {self.degrade!r} "
+                             "(expected cpu or off)")
+        self._jit_cache: Dict[Tuple, Tuple[Callable, Dict]] = _LruDict(64)
+        # escalated capacities survive per plan (keyed by the root node
+        # object — identity hash, and the strong ref pins it so a recycled
+        # id() can never alias a dead plan): the next execute() starts from
+        # the grown caps instead of re-paying the whole overflow ladder
+        self._caps_memo: Dict[PlanNode, Dict[str, int]] = _LruDict(256)
 
     # ---- entry point ------------------------------------------------------
     def execute(self, plan: Plan, inputs: Dict[str, Table]) -> PlanResult:
@@ -187,6 +288,51 @@ class PlanExecutor:
         if inj is not None:
             inj.on_compute(f"plan.{node.kind}")
 
+    # ---- health / degradation policy --------------------------------------
+    def _breaker_snapshot(self) -> Dict:
+        br = self.health.breaker
+        return {"state": br.state, "trips": br.trips,
+                "reason": br.last_trip_reason, "error": br.last_trip_error}
+
+    def _handle_fault(self, err, op_label: str, attempt: int,
+                      metric: OperatorMetrics) -> bool:
+        """One failure on the device path. Returns True when the caller
+        should retry the failed unit (backoff already slept, counters
+        bumped); returns False when the breaker tripped and the caller must
+        degrade (or re-raise under degrade="off")."""
+        from ..runtime import health as _h
+        kind = self.health.record_failure(op_label, err)
+        if kind == _h.TRANSIENT:
+            if attempt < self.op_retries:
+                slept = self.health.try_retry(attempt)
+                if slept is not None:
+                    metric.retries += 1
+                    metric.backoff_ms += slept
+                    self._maybe_rollback(err)
+                    return True
+                kind = _h.STICKY        # shared retry budget exhausted
+            else:
+                kind = _h.STICKY        # per-op retry bound exhausted
+        self.health.trip(kind, err)
+        return False
+
+    def _maybe_rollback(self, err) -> None:
+        """RetryOOM transients: honor the arbiter's rollback contract
+        (block until memory frees) before the backoff retry, best-effort."""
+        from ..runtime.adaptor import CpuRetryOOM, RetryOOM
+        if not isinstance(err, (RetryOOM, CpuRetryOOM)):
+            return
+        sess = self.session
+        if sess is None:
+            from ..runtime.admission import get_active_session
+            sess = get_active_session()
+        if sess is None:
+            return
+        try:
+            sess.arbiter.block_thread_until_ready()
+        except Exception:
+            pass
+
     # ---- eager tier -------------------------------------------------------
     def _execute_eager(self, plan, inputs, schemas) -> PlanResult:
         from ..runtime.admission import operand_nbytes
@@ -194,36 +340,163 @@ class PlanExecutor:
         t_plan0 = time.perf_counter()
         results: Dict[int, Table] = {}
         metrics: Dict[str, OperatorMetrics] = {}
-        for node in plan.nodes:
-            child_tables = [results[id(c)] for c in node.children]
-            m = OperatorMetrics(label=node.label, kind=node.kind,
-                                describe=node.describe())
-            t0 = time.perf_counter()
-            for attempt in range(self.op_retries + 1):
+        self.health.start_plan_attempt()
+        if self.degrade != "off" and not self.health.admit():
+            # device quarantined (breaker open / failed half-open probe):
+            # run the whole plan on the CPU tier without touching it
+            return self._execute_degraded(plan, inputs, schemas, results,
+                                          metrics, start=0, t_plan0=t_plan0,
+                                          mode="eager")
+        try:
+            for i, node in enumerate(plan.nodes):
+                child_tables = [results[id(c)] for c in node.children]
+                m = OperatorMetrics(label=node.label, kind=node.kind,
+                                    describe=node.describe())
+                t0 = time.perf_counter()
+                attempt = 0
+                out = None
+                while True:
+                    try:
+                        with tracing.range_ctx(f"plan.{node.label}"):
+                            self._faultinj_point(node)
+                            out = self._exec_eager_node(node, child_tables,
+                                                        inputs, schemas, m)
+                        break
+                    except _fault_surface() as err:
+                        if self._handle_fault(err, node.label, attempt, m):
+                            attempt += 1
+                            continue
+                        if self.degrade == "off":
+                            raise
+                        return self._execute_degraded(
+                            plan, inputs, schemas, results, metrics,
+                            start=i, t_plan0=t_plan0, mode="eager",
+                            first_metric=m)
+                if attempt:
+                    # retried to success: the fault was genuinely transient,
+                    # so it must not count toward a later sticky trip
+                    self.health.record_success(node.label)
+                if self.block_per_op:
+                    jax.block_until_ready([c.data for c in out.columns])
+                # wall is compute (all attempts), NOT the backoff idle time —
+                # that is reported separately in backoff_ms, not double-counted
+                m.wall_ms = (time.perf_counter() - t0) * 1e3 - m.backoff_ms
+                m.rows_in = sum(t.num_rows for t in child_tables)
+                m.rows_out = out.num_rows
+                m.bytes_out = operand_nbytes(out)
+                metrics[node.label] = m
+                results[id(node)] = out
+        except BaseException as err:
+            # debuggability: a failed plan still surfaces what completed.
+            # First attachment wins — a failed degraded re-run has already
+            # recorded ITS metrics, which the stale device-tier dict here
+            # must not clobber.
+            if not hasattr(err, "plan_metrics"):
                 try:
-                    with tracing.range_ctx(f"plan.{node.label}"):
-                        self._faultinj_point(node)
-                        out = self._exec_eager_node(node, child_tables,
-                                                    inputs, schemas, m)
-                    break
-                except _recoverable_faults():
-                    if attempt == self.op_retries:
-                        raise
-                    m.retries += 1
-            if self.block_per_op:
-                jax.block_until_ready([c.data for c in out.columns])
-            m.wall_ms = (time.perf_counter() - t0) * 1e3
-            m.rows_in = sum(t.num_rows for t in child_tables)
-            m.rows_out = out.num_rows
-            m.bytes_out = operand_nbytes(out)
-            metrics[node.label] = m
-            results[id(node)] = out
+                    err.plan_metrics = dict(metrics)
+                except Exception:
+                    pass
+            raise
         wall = (time.perf_counter() - t_plan0) * 1e3
         return PlanResult(plan, results[id(plan.root)], None, metrics,
-                          "eager", wall)
+                          "eager", wall,
+                          retries=sum(mm.retries for mm in metrics.values()),
+                          breaker=self._breaker_snapshot(),
+                          backoff_ms=sum(mm.backoff_ms
+                                         for mm in metrics.values()))
+
+    # ---- degraded CPU tier ------------------------------------------------
+    def _execute_degraded(self, plan, inputs, schemas, results, metrics,
+                          start: int, t_plan0: float, mode: str,
+                          first_metric: Optional[OperatorMetrics] = None,
+                          carry_retries: int = 0,
+                          carry_backoff_ms: float = 0.0,
+                          attempts: int = 1,
+                          caps: Optional[Dict[str, int]] = None) -> PlanResult:
+        """Finish the plan on the CPU backend tier after a breaker trip.
+
+        Completed operator outputs are salvaged through host memory onto
+        the CPU backend; the remaining nodes re-execute eagerly with ALL
+        faultinj interception suppressed (`faultinj.suppressed()` — the
+        CPU tier does not touch the quarantined device, so neither the op
+        shims, the MemoryBudget shims, nor the poisoned-device fail-fast
+        may fire here) and no plan-level injection points. If the salvage
+        itself fails (device buffers already lost), the whole plan re-runs
+        from the scans. Admission still applies — degraded work is
+        budgeted like any other."""
+        import contextlib
+        from .. import faultinj
+        from ..runtime.admission import operand_nbytes
+        from ..utils import tracing
+        self.health.note_degraded_plan()
+        cpu = _cpu_device()
+        ctx = (jax.default_device(cpu) if cpu is not None
+               else contextlib.nullcontext())
+        with faultinj.suppressed(), ctx:
+            try:
+                cpu_results = {k: _table_to_cpu(t, cpu)
+                               for k, t in results.items()}
+                cpu_inputs = {k: _table_to_cpu(t, cpu)
+                              for k, t in inputs.items()}
+            except Exception:
+                # device buffers unrecoverable: restart from the bound inputs
+                # (host-side numpy survives a dead device; device copies may
+                # not — re-binding is the caller's contract then). The
+                # retries/backoff already paid on the device path survive
+                # into the carry so the result still reports them.
+                carry_retries += sum(mm.retries for mm in metrics.values())
+                carry_backoff_ms += sum(mm.backoff_ms
+                                        for mm in metrics.values())
+                if first_metric is not None:
+                    carry_retries += first_metric.retries
+                    carry_backoff_ms += first_metric.backoff_ms
+                cpu_results, cpu_inputs = {}, inputs
+                metrics = {}
+                start = 0
+                first_metric = None
+            try:
+                for node in plan.nodes[start:]:
+                    childs = [cpu_results[id(c)] for c in node.children]
+                    if first_metric is not None and node is plan.nodes[start]:
+                        m = first_metric  # keep the failed op's retry record
+                    else:
+                        m = OperatorMetrics(label=node.label, kind=node.kind,
+                                            describe=node.describe())
+                    m.degraded = True
+                    t0 = time.perf_counter()
+                    with tracing.range_ctx(f"plan.{node.label}.degraded"):
+                        out = self._exec_eager_node(node, childs, cpu_inputs,
+                                                    schemas, m,
+                                                    allow_mesh=False)
+                    if self.block_per_op:
+                        jax.block_until_ready([c.data for c in out.columns])
+                    m.wall_ms = (time.perf_counter() - t0) * 1e3
+                    m.rows_in = sum(t.num_rows for t in childs)
+                    m.rows_out = out.num_rows
+                    m.bytes_out = operand_nbytes(out)
+                    metrics[node.label] = m
+                    cpu_results[id(node)] = out
+            except BaseException as err:
+                # the debuggability contract holds on THIS tier too: a
+                # failed degraded plan still surfaces what completed
+                try:
+                    err.plan_metrics = dict(metrics)
+                except Exception:
+                    pass
+                raise
+        wall = (time.perf_counter() - t_plan0) * 1e3
+        return PlanResult(plan, cpu_results[id(plan.root)], None, metrics,
+                          mode, wall, degraded=True,
+                          attempts=attempts, caps=caps,
+                          retries=carry_retries + sum(
+                              mm.retries for mm in metrics.values()),
+                          breaker=self._breaker_snapshot(),
+                          backoff_ms=carry_backoff_ms + sum(
+                              mm.backoff_ms for mm in metrics.values()))
 
     def _exec_eager_node(self, node, childs: List[Table], inputs, schemas,
-                         m: OperatorMetrics) -> Table:
+                         m: OperatorMetrics,
+                         allow_mesh: bool = True) -> Table:
         ops = _ops()
         if isinstance(node, Scan):
             return inputs[node.source]
@@ -251,7 +524,8 @@ class PlanExecutor:
             return ops.take_table(lt, keep.data, _has_negative=False)
         if isinstance(node, HashAggregate):
             (t,) = childs
-            if self.mesh is not None and isinstance(node.child, Exchange):
+            if (self.mesh is not None and allow_mesh
+                    and isinstance(node.child, Exchange)):
                 return self._exec_distributed_aggregate(node, t, m)
             if not node.keys:
                 return self._global_aggregate(t, node)
@@ -423,11 +697,20 @@ class PlanExecutor:
 
     def _execute_capped(self, plan, inputs, schemas) -> PlanResult:
         from ..parallel.autoretry import auto_retry_overflow
+        # start from the input-derived defaults, floored up by any caps the
+        # plan already escalated to: the memo must never UNDERSIZE a run on
+        # larger inputs than it was learned on (only skip re-learning)
         caps = self._default_caps(plan, inputs)
+        for k, v in (self._caps_memo.get(plan.root) or {}).items():
+            caps[k] = max(caps.get(k, 0), v)
         t0 = time.perf_counter()
         attempts = 0
         bytes_map: Dict[str, int] = {}
         last_caps = dict(caps)
+        self.health.start_plan_attempt()
+        if self.degrade != "off" and not self.health.admit():
+            return self._execute_degraded(plan, inputs, schemas, {}, {},
+                                          start=0, t_plan0=t0, mode="capped")
 
         def run(**caps_now):
             nonlocal attempts
@@ -438,26 +721,47 @@ class PlanExecutor:
             # cache-hit runs where the op-level shims never re-trace
             for node in plan.nodes:
                 self._faultinj_point(node)
-            fn, bm = self._jitted_capped(plan, schemas, caps_now,
-                                         tuple(sorted(inputs)))
+            # shapes in the key: jax retraces per input shape anyway, and a
+            # per-shape entry keeps each bytes_map true to ITS trace (a
+            # shared dict would serve one shape's bytes to another's run)
+            fn, bm = self._jitted_capped(
+                plan, schemas, caps_now,
+                tuple(sorted((n, t.num_rows) for n, t in inputs.items())))
             out = fn(dict(inputs))
             bytes_map.clear()
             bytes_map.update(bm)    # bm fills during the first trace
             return out
 
         retries = 0
+        backoff_total = 0.0
+        plan_metric = OperatorMetrics(label="plan", kind="Plan")
         while True:
             try:
                 (table, valid, counts, overflow), final_caps = \
                     auto_retry_overflow(run, caps, self.max_cap_attempts)
+                if retries:
+                    self.health.record_success("plan")
+                self._caps_memo[plan.root] = dict(final_caps)
                 break
-            except _recoverable_faults():
-                if retries >= self.op_retries:
+            except _fault_surface() as err:
+                # failures are plan-granular here (one XLA program), so the
+                # sticky window keys on the plan attempt, not an operator
+                if self._handle_fault(err, "plan", retries, plan_metric):
+                    retries += 1
+                    backoff_total = plan_metric.backoff_ms
+                    # resume from the escalated capacities, not the
+                    # originals: growth already paid for must survive
+                    caps = dict(last_caps)
+                    continue
+                if self.degrade == "off":
                     raise
-                retries += 1
-                # resume from the escalated capacities, not the originals:
-                # growth already paid for must survive the fault re-run
-                caps = dict(last_caps)
+                return self._execute_degraded(
+                    plan, inputs, schemas, {}, {}, start=0, t_plan0=t0,
+                    mode="capped", carry_retries=plan_metric.retries,
+                    carry_backoff_ms=plan_metric.backoff_ms,
+                    # escalation history survives the trip: the device path
+                    # DID run `attempts` times over these (grown) caps
+                    attempts=attempts, caps=dict(last_caps))
         jax.block_until_ready(valid)
         wall = (time.perf_counter() - t0) * 1e3
         metrics: Dict[str, OperatorMetrics] = {}
@@ -472,17 +776,24 @@ class PlanExecutor:
             rows_in, rows_out = counts_np[node.label]
             uses_cap = (isinstance(node, HashJoin) and node.how == "inner") \
                 or (isinstance(node, HashAggregate) and node.keys)
+            # retries are plan-granular in this tier (one XLA program) and
+            # live on PlanResult.retries — copying them onto every row would
+            # make per-op aggregation overcount N-fold
             metrics[node.label] = OperatorMetrics(
                 label=node.label, kind=node.kind, describe=node.describe(),
                 rows_in=rows_in, rows_out=rows_out,
                 bytes_out=bytes_map.get(node.label, 0),
-                retries=retries, escalations=escal if uses_cap else 0)
+                escalations=escal if uses_cap else 0)
         return PlanResult(plan, table, valid, metrics, "capped", wall,
                           attempts=attempts, caps=final_caps,
-                          retries=retries)
+                          retries=retries,
+                          breaker=self._breaker_snapshot(),
+                          backoff_ms=backoff_total)
 
     def _jitted_capped(self, plan, schemas, caps, input_key):
-        key = (id(plan.root), tuple(sorted(caps.items())), input_key)
+        # the root NODE is the key (identity hash, strong ref — same scheme
+        # as _caps_memo), so a recycled id() can never alias a dead plan
+        key = (plan.root, tuple(sorted(caps.items())), input_key)
         hit = self._jit_cache.get(key)
         if hit is not None:
             return hit

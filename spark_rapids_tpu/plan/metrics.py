@@ -29,6 +29,8 @@ class OperatorMetrics:
     wall_ms: Optional[float] = None   # per-op wall (eager tier only)
     retries: int = 0           # operator re-runs after injected/device faults
     escalations: int = 0       # cap-growth retries charged to this node
+    backoff_ms: float = 0.0    # time spent backing off before retries
+    degraded: bool = False     # ran on the degraded CPU tier (breaker open)
 
     def to_dict(self) -> Dict:
         return dataclasses.asdict(self)
@@ -37,20 +39,30 @@ class OperatorMetrics:
 def render_profile(rows: List[OperatorMetrics],
                    plan_wall_ms: Optional[float] = None,
                    attempts: int = 1,
-                   caps: Optional[Dict] = None) -> str:
+                   caps: Optional[Dict] = None,
+                   degraded: bool = False,
+                   breaker: Optional[Dict] = None) -> str:
     """Human-readable profile table (the `profile()` text form)."""
     out = []
     if plan_wall_ms is not None:
         caps_s = f" caps={caps}" if caps else ""
         out.append(f"plan: {plan_wall_ms:.3f} ms, "
                    f"{attempts} attempt(s){caps_s}")
+    if degraded:
+        reason = (breaker or {}).get("reason")
+        state = (breaker or {}).get("state", "open")
+        out.append(f"DEGRADED: breaker {state}"
+                   f"{f' ({reason})' if reason else ''}; "
+                   "plan completed on the CPU tier")
     hdr = (f"{'operator':<28} {'rows_in':>10} {'rows_out':>10} "
-           f"{'bytes_out':>12} {'wall_ms':>9} {'retry':>5} {'escal':>5}")
+           f"{'bytes_out':>12} {'wall_ms':>9} {'retry':>5} {'escal':>5} "
+           f"{'backoff':>8} {'deg':>4}")
     out.append(hdr)
     out.append("-" * len(hdr))
     for m in rows:
         wall = f"{m.wall_ms:.3f}" if m.wall_ms is not None else "-"
         out.append(f"{m.label:<28} {m.rows_in:>10} {m.rows_out:>10} "
                    f"{m.bytes_out:>12} {wall:>9} {m.retries:>5} "
-                   f"{m.escalations:>5}")
+                   f"{m.escalations:>5} {m.backoff_ms:>8.1f} "
+                   f"{'yes' if m.degraded else '-':>4}")
     return "\n".join(out)

@@ -14,6 +14,8 @@ from .adaptor import (ResourceArbiter, OomInjectionType, current_thread_id,
 from .pool import (DeviceSession, MemoryBudget, MemoryEventHandler,
                    Reservation)
 from .retry import with_retry
+from .health import (DeviceHealthMonitor, CircuitBreaker, device_probe,
+                     CLOSED, OPEN, HALF_OPEN, TRANSIENT, STICKY, FATAL)
 from .admission import (set_active_session, get_active_session,
                         active_session, admitted_op, operand_nbytes)
 from .spill import SpillPool, SpillableBuffer, SpillableTable
@@ -27,6 +29,8 @@ __all__ = [
     "CpuSplitAndRetryOOM", "HardOOM", "InjectedException", "ThreadRemovedError",
     "MemoryBudget", "MemoryEventHandler", "DeviceSession", "Reservation",
     "with_retry",
+    "DeviceHealthMonitor", "CircuitBreaker", "device_probe",
+    "CLOSED", "OPEN", "HALF_OPEN", "TRANSIENT", "STICKY", "FATAL",
     "STATE_UNKNOWN", "STATE_RUNNING", "STATE_ALLOC", "STATE_ALLOC_FREE",
     "STATE_BLOCKED", "STATE_BUFN_THROW", "STATE_BUFN_WAIT", "STATE_BUFN",
     "STATE_SPLIT_THROW", "STATE_REMOVE_THROW", "STATE_NAMES",

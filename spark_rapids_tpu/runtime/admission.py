@@ -124,7 +124,13 @@ class _SharedRelease:
             self.count -= 1
             done = self.count == 0
         if done:
-            self.budget.release(self.reservation)
+            # runs from a weakref finalizer on an arbitrary thread: the
+            # release is host-side accounting and must always land — a
+            # poisoned-device fail-fast here would leak budget forever and
+            # never wake blocked threads
+            from .. import faultinj
+            with faultinj.suppressed():
+                self.budget.release(self.reservation)
 
 
 def _weakrefable_outputs(out: Any) -> list:

@@ -7,7 +7,8 @@ process halves. `with_retry` packages that protocol for TPU operator code.
 """
 from __future__ import annotations
 
-from typing import Callable, List, Optional, Sequence, TypeVar
+from collections import deque
+from typing import Callable, Deque, List, Optional, Sequence, TypeVar
 
 from .adaptor import (ResourceArbiter, RetryOOM, CpuRetryOOM,
                       SplitAndRetryOOM, CpuSplitAndRetryOOM)
@@ -27,8 +28,13 @@ def with_retry(arbiter: ResourceArbiter,
     split. `split` must return the pieces of its argument; when absent, a
     SplitAndRetryOOM is re-raised (nothing left to give back).
     `on_rollback` runs after a RetryOOM so callers can make state spillable.
+
+    The work queue is a deque: split pieces push back onto the head with
+    O(1) extendleft, so a deep split cascade (every piece splitting again)
+    stays O(n) total instead of the O(n²) a list-head `work[0:1] = pieces`
+    rewrite costs.
     """
-    work: List[A] = [batch]
+    work: Deque[A] = deque([batch])
     out: List[T] = []
 
     def do_split(item: A) -> None:
@@ -37,7 +43,8 @@ def with_retry(arbiter: ResourceArbiter,
         pieces = list(split(item))
         if len(pieces) <= 1:
             raise
-        work[0:1] = pieces
+        work.popleft()
+        work.extendleft(reversed(pieces))   # head-first, original order
 
     arbiter.start_retry_block()
     try:
@@ -45,7 +52,7 @@ def with_retry(arbiter: ResourceArbiter,
             item = work[0]
             try:
                 out.append(attempt(item))
-                work.pop(0)
+                work.popleft()
             except (RetryOOM, CpuRetryOOM):
                 if on_rollback is not None:
                     on_rollback()

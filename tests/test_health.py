@@ -1,0 +1,480 @@
+"""Device health monitor, circuit breaker, and degraded CPU-tier tests.
+
+Unit level: failure taxonomy (transient/sticky/fatal), jittered exponential
+backoff against the shared per-plan-attempt retry budget, the breaker state
+machine (closed → open → half_open), and arbiter-style get-and-reset metric
+drains. End to end: a fatal injected fault mid-plan completes degraded on
+the CPU tier with result parity, `reset_device()` arms a half-open probe,
+and the probe restores normal execution — the recovery story the fault
+injector exists to prove (docs/robustness.md).
+"""
+import json
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import spark_rapids_tpu  # noqa: F401
+from spark_rapids_tpu import Column, Table, dtypes, faultinj
+from spark_rapids_tpu.plan import PlanBuilder, PlanExecutor, col, lit
+from spark_rapids_tpu.runtime.health import (CLOSED, FATAL, HALF_OPEN, OPEN,
+                                             STICKY, TRANSIENT,
+                                             CircuitBreaker,
+                                             DeviceHealthMonitor,
+                                             device_probe)
+
+
+def _col(a):
+    a = np.asarray(a, dtype=np.int64)
+    return Column(dtype=dtypes.INT64, length=len(a), data=jnp.asarray(a))
+
+
+def _tables(n=2000, seed=0):
+    rng = np.random.default_rng(seed)
+    sales = Table([_col(rng.integers(0, 50, n)),
+                   _col(rng.integers(1, 100, n))], names=["k", "v"])
+    dims = Table([_col(np.arange(50)), _col(np.arange(50) % 3)],
+                 names=["dk", "grp"])
+    return sales, dims
+
+
+def _plan():
+    b = PlanBuilder()
+    s = b.scan("sales", schema=["k", "v"])
+    d = b.scan("dims", schema=["dk", "grp"]).filter(col("grp") == 1)
+    return (s.join(d, left_on="k", right_on="dk")
+             .project({"grp": col("grp"), "rev": col("v") * lit(2)})
+             .aggregate(["grp"], [("rev", "sum", "total")])
+             .sort(["grp"])
+             .build())
+
+
+def _write_cfg(tmp_path, cfg):
+    p = tmp_path / "faultinj.json"
+    p.write_text(json.dumps(cfg))
+    return str(p)
+
+
+@pytest.fixture
+def _clean_faultinj():
+    yield
+    faultinj.uninstall()
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _monitor(**kw):
+    """Monitor with no real sleeping and a deterministic rng."""
+    slept = []
+    kw.setdefault("sleep", slept.append)
+    kw.setdefault("rng", random.Random(7))
+    m = DeviceHealthMonitor(**kw)
+    m._test_sleeps = slept
+    return m
+
+
+# ---- taxonomy ---------------------------------------------------------------
+
+def test_classify_fatal_and_transient():
+    hm = _monitor()
+    assert hm.record_failure("op", faultinj.DeviceFatalError("x")) == FATAL
+    assert hm.record_failure("op", faultinj.DeviceAssertError("x")) == TRANSIENT
+    assert hm.record_failure("op", faultinj.InjectedReturnCode("op", 2)) \
+        == TRANSIENT
+
+
+def test_classify_sticky_same_op_within_window():
+    clock = _FakeClock()
+    hm = _monitor(clock=clock, sticky_threshold=3, sticky_window_s=60)
+    e = faultinj.DeviceAssertError("x")
+    assert hm.record_failure("HashJoin#1", e) == TRANSIENT
+    assert hm.record_failure("HashJoin#1", e) == TRANSIENT
+    # a different op does not contribute to HashJoin#1's window
+    assert hm.record_failure("Sort#2", e) == TRANSIENT
+    assert hm.record_failure("HashJoin#1", e) == STICKY
+
+
+def test_probe_recovery_clears_sticky_window():
+    """Cooldown+probe recovery (no reset_device) must also restart the
+    windows: a single post-recovery transient may not instantly re-trip."""
+    clock = _FakeClock()
+    hm = _monitor(clock=clock, sticky_threshold=3, sticky_window_s=60,
+                  probe=lambda: True)
+    e = faultinj.DeviceAssertError("x")
+    hm.record_failure("op", e)
+    hm.record_failure("op", e)
+    assert hm.record_failure("op", e) == STICKY
+    hm.trip(STICKY)
+    hm.breaker.half_open()
+    assert hm.probe()                         # recovered
+    clock.t += 1                              # still inside the old window
+    assert hm.record_failure("op", e) == TRANSIENT
+    drained = hm.get_and_reset_metrics()
+    assert drained["sticky_faults"] == 1      # only the classifying failure
+    assert drained["transient_faults"] == 3
+
+
+def test_success_clears_sticky_window():
+    """Absorbed transients must not accumulate across executions: a unit
+    that eventually succeeds resets its op's failure window, so sticky
+    means repeated failure with NO intervening success."""
+    hm = _monitor(sticky_threshold=3)
+    e = faultinj.DeviceAssertError("x")
+    for _ in range(5):                        # one absorbed fault per "job"
+        assert hm.record_failure("HashJoin#1", e) == TRANSIENT
+        hm.record_success("HashJoin#1")       # the retry succeeded
+    assert hm.record_failure("HashJoin#1", e) == TRANSIENT
+
+
+def test_sticky_window_ages_out():
+    clock = _FakeClock()
+    hm = _monitor(clock=clock, sticky_threshold=2, sticky_window_s=10)
+    e = faultinj.DeviceAssertError("x")
+    assert hm.record_failure("op", e) == TRANSIENT
+    clock.t += 11                      # first failure leaves the window
+    assert hm.record_failure("op", e) == TRANSIENT
+    clock.t += 1
+    assert hm.record_failure("op", e) == STICKY
+
+
+# ---- backoff + budget -------------------------------------------------------
+
+def test_backoff_exponential_jittered_and_capped():
+    hm = _monitor(retry_budget=100, backoff_base_ms=10, backoff_max_ms=200)
+    for attempt, lo_hi in enumerate([(5, 10), (10, 20), (20, 40), (40, 80),
+                                     (80, 160), (100, 200), (100, 200)]):
+        ms = hm.try_retry(attempt)
+        lo, hi = lo_hi
+        assert lo <= ms <= hi, (attempt, ms)
+    # the sleeps actually happened (injected recorder, seconds)
+    assert len(hm._test_sleeps) == 7
+    assert all(s >= 0.005 for s in hm._test_sleeps)
+
+
+def test_retry_budget_shared_and_refilled_per_attempt():
+    hm = _monitor(retry_budget=3, backoff_base_ms=1)
+    assert all(hm.try_retry(0) is not None for _ in range(3))
+    assert hm.try_retry(0) is None            # exhausted: caller escalates
+    hm.start_plan_attempt()                   # new plan attempt refills
+    assert hm.try_retry(0) is not None
+    drained = hm.get_and_reset_metrics()
+    assert drained["budget_exhausted"] == 1
+    assert drained["retries"] == 4
+
+
+# ---- breaker state machine --------------------------------------------------
+
+def test_breaker_lifecycle():
+    ok = {"v": True}
+    br = CircuitBreaker(probe=lambda: ok["v"])
+    assert br.state == CLOSED and br.admit()
+    br.trip("sticky")
+    assert br.state == OPEN and not br.admit()
+    assert br.trips == 1 and br.last_trip_reason == "sticky"
+    br.half_open()
+    assert br.state == HALF_OPEN
+    ok["v"] = False
+    assert not br.admit()                     # failed probe re-opens
+    assert br.state == OPEN
+    br.half_open()
+    ok["v"] = True
+    assert br.admit()                         # probe success closes
+    assert br.state == CLOSED
+
+
+def test_breaker_cooldown_self_arms_half_open():
+    """Quarantine is never permanent: once cooldown_s elapses, admit()
+    probes; a failed probe re-opens AND restarts the cooldown clock."""
+    clock = _FakeClock()
+    ok = {"v": False}
+    br = CircuitBreaker(probe=lambda: ok["v"], cooldown_s=30, clock=clock)
+    br.trip("sticky")
+    assert not br.admit()                     # still cooling down
+    clock.t += 31
+    assert not br.admit()                     # probe ran, failed -> OPEN
+    assert br.state == OPEN
+    clock.t += 10
+    assert not br.admit()                     # cooldown restarted at fail
+    ok["v"] = True
+    clock.t += 31
+    assert br.admit()                         # cooldown -> probe -> CLOSED
+    assert br.state == CLOSED
+
+
+def test_breaker_cooldown_zero_disables_self_arm():
+    clock = _FakeClock()
+    br = CircuitBreaker(probe=lambda: True, cooldown_s=0, clock=clock)
+    br.trip("fatal")
+    clock.t += 1e9
+    assert not br.admit()                     # only reset_device() re-arms
+    br.half_open()
+    assert br.admit()
+
+
+def test_retry_budget_is_per_thread():
+    """Concurrent plans on a shared monitor get independent budgets: one
+    thread's refill or exhaustion must not leak into another's bound."""
+    import threading
+    hm = _monitor(retry_budget=2, backoff_base_ms=1)
+    hm.start_plan_attempt()
+    assert hm.try_retry(0) is not None and hm.try_retry(0) is not None
+    assert hm.try_retry(0) is None            # this thread: exhausted
+    got = {}
+
+    def other():
+        hm.start_plan_attempt()               # refills ONLY its thread
+        got["ok"] = hm.try_retry(0) is not None
+
+    t = threading.Thread(target=other)
+    t.start(); t.join()
+    assert got["ok"]                          # fresh budget over there
+    assert hm.try_retry(0) is None            # still exhausted here
+
+
+def test_breaker_probe_exception_counts_as_failure():
+    def boom():
+        raise faultinj.DeviceFatalError("still dead")
+    br = CircuitBreaker(probe=boom)
+    br.trip("fatal")
+    br.half_open()
+    assert not br.probe()
+    assert br.state == OPEN
+
+
+def test_device_probe_runs_tiny_device_op():
+    assert device_probe()                     # no injector installed
+
+
+def test_device_probe_fails_on_poisoned_device(tmp_path, _clean_faultinj):
+    faultinj.install(_write_cfg(tmp_path, {"computeFaults": {
+        "probe-arm": {"percent": 100, "injectionType": 0}}}))
+    with pytest.raises(faultinj.DeviceFatalError):
+        faultinj.active().on_compute("probe-arm")  # poison via a fatal fault
+    br = CircuitBreaker()
+    br.trip("fatal")
+    br.half_open()
+    assert not br.probe()                     # poisoned device refuses
+    faultinj.active().reset_device()
+    br.half_open()
+    assert br.probe()
+    assert br.state == CLOSED
+
+
+# ---- metrics drain ----------------------------------------------------------
+
+def test_metrics_get_and_reset():
+    hm = _monitor(backoff_base_ms=1)
+    hm.record_failure("op", faultinj.DeviceAssertError("x"))
+    hm.try_retry(0)
+    hm.trip("sticky")
+    hm.note_degraded_plan()
+    first = hm.get_and_reset_metrics()
+    assert first["transient_faults"] == 1
+    assert first["retries"] == 1 and first["backoff_ms"] > 0
+    assert first["trips"] == 1 and first["sticky_trips"] == 1
+    assert first["degraded_plans"] == 1
+    assert hm.get_and_reset_metrics() == {}   # drained
+
+
+def test_reset_device_clears_poison_and_runs_hooks(tmp_path, _clean_faultinj):
+    faultinj.install(_write_cfg(tmp_path, {"computeFaults": {
+        "arm": {"percent": 100, "injectionType": 0}}}))
+    with pytest.raises(faultinj.DeviceFatalError):
+        faultinj.active().on_compute("arm")
+    assert faultinj.active().device_poisoned
+    hm = _monitor()
+    e = faultinj.DeviceAssertError("x")
+    hm.record_failure("HashJoin#1", e)
+    hm.record_failure("HashJoin#1", e)
+    hm.trip("fatal")
+    ran = []
+    hm.add_reset_hook(lambda: ran.append(True))
+    hm.reset_device()
+    assert not faultinj.active().device_poisoned
+    assert ran == [True]
+    assert hm.breaker.state == HALF_OPEN
+    # stickiness windows restart at the reset: pre-recovery failures must
+    # not re-trip the breaker on the first post-recovery transient
+    assert hm.record_failure("HashJoin#1", e) == TRANSIENT
+
+
+# ---- end to end: fatal mid-plan → degraded → reset → half-open → closed -----
+
+def test_fatal_mid_plan_degrades_then_recovers(tmp_path, _clean_faultinj):
+    sales, dims = _tables()
+    plan = _plan()
+    ref = PlanExecutor().execute(plan, {"sales": sales, "dims": dims})
+    ref_dict = ref.table.to_pydict()
+    assert not ref.degraded and ref.breaker["state"] == "closed"
+
+    # fatal fault at the Sort — everything upstream has already executed
+    faultinj.install(_write_cfg(tmp_path, {"computeFaults": {
+        "plan.Sort": {"percent": 100, "injectionType": 0,
+                      "interceptionCount": 1}}}))
+    ex = PlanExecutor()
+    res = ex.execute(plan, {"sales": sales, "dims": dims})
+    assert res.degraded
+    assert res.breaker["state"] == "open" and res.breaker["trips"] == 1
+    assert res.breaker["reason"] == "fatal"
+    assert "DeviceFatalError" in res.breaker["error"]  # the actual culprit
+    assert res.table.to_pydict() == ref_dict          # parity via CPU tier
+    by_kind = {m.kind: m for m in res.metrics.values()}
+    assert by_kind["Sort"].degraded                   # re-ran on the CPU tier
+    assert not by_kind["HashJoin"].degraded           # completed pre-trip
+    assert by_kind["Sort"].retries == 0               # fatal: never retried
+    health = ex.health.get_and_reset_metrics()
+    assert health["fatal_faults"] == 1 and health["fatal_trips"] == 1
+    assert health["degraded_plans"] == 1
+
+    # breaker open: the device is quarantined, plans run fully degraded
+    res2 = ex.execute(plan, {"sales": sales, "dims": dims})
+    assert res2.degraded
+    assert all(m.degraded for m in res2.metrics.values())
+    assert res2.table.to_pydict() == ref_dict
+
+    # operator intervention: reset_device() arms the half-open probation
+    # and the heartbeat probe closes the breaker on the next execute
+    ex.health.reset_device()
+    assert not faultinj.active().device_poisoned
+    assert ex.health.breaker.state == HALF_OPEN
+    res3 = ex.execute(plan, {"sales": sales, "dims": dims})
+    assert not res3.degraded
+    assert res3.breaker["state"] == "closed"
+    assert res3.table.to_pydict() == ref_dict
+    health = ex.health.get_and_reset_metrics()
+    assert health["probes"] == 1 and "probe_failures" not in health
+
+
+def test_sticky_storm_trips_and_degrades(tmp_path, _clean_faultinj):
+    sales, dims = _tables()
+    plan = _plan()
+    ref = PlanExecutor().execute(plan, {"sales": sales, "dims": dims})
+    faultinj.install(_write_cfg(tmp_path, {"computeFaults": {
+        "plan.HashAggregate": {"percent": 100, "injectionType": 1}}}))
+    hm = _monitor(backoff_base_ms=1)          # no real sleeping
+    ex = PlanExecutor(health=hm)
+    res = ex.execute(plan, {"sales": sales, "dims": dims})
+    assert res.degraded and res.breaker["reason"] == "sticky"
+    assert res.table.to_pydict() == ref.table.to_pydict()
+    agg = next(m for m in res.metrics.values() if m.kind == "HashAggregate")
+    assert agg.retries == 2 and agg.degraded  # bounded retry, then degrade
+    assert res.backoff_ms > 0
+
+
+def test_retry_budget_exhaustion_degrades(tmp_path, _clean_faultinj):
+    """A whole-plan fault storm burns the shared budget, not per-op counts:
+    with a budget of 1, the second failing operator may not retry at all."""
+    sales, dims = _tables()
+    plan = _plan()
+    ref = PlanExecutor().execute(plan, {"sales": sales, "dims": dims})
+    faultinj.install(_write_cfg(tmp_path, {"computeFaults": {
+        "plan.Project": {"percent": 100, "injectionType": 1},
+        "plan.Sort": {"percent": 100, "injectionType": 1}}}))
+    hm = _monitor(backoff_base_ms=1, retry_budget=1, sticky_threshold=99)
+    ex = PlanExecutor(health=hm)
+    res = ex.execute(plan, {"sales": sales, "dims": dims})
+    assert res.degraded and res.breaker["reason"] == "sticky"
+    assert res.table.to_pydict() == ref.table.to_pydict()
+    drained = hm.get_and_reset_metrics()
+    assert drained["budget_exhausted"] >= 1
+    assert drained["retries"] == 1            # the budget, not 3 per op
+
+
+def test_degrade_off_fatal_raises_with_metrics(tmp_path, _clean_faultinj):
+    sales, dims = _tables()
+    faultinj.install(_write_cfg(tmp_path, {"computeFaults": {
+        "plan.Sort": {"percent": 100, "injectionType": 0,
+                      "interceptionCount": 1}}}))
+    with pytest.raises(faultinj.DeviceFatalError) as ei:
+        PlanExecutor(degrade="off").execute(
+            _plan(), {"sales": sales, "dims": dims})
+    done = {m.kind for m in ei.value.plan_metrics.values()}
+    assert "HashAggregate" in done and "Sort" not in done
+
+
+def test_capped_fatal_degrades_with_parity(tmp_path, _clean_faultinj):
+    sales, dims = _tables()
+    plan = _plan()
+    ref = PlanExecutor().execute(plan, {"sales": sales, "dims": dims})
+    faultinj.install(_write_cfg(tmp_path, {"computeFaults": {
+        "plan.HashJoin": {"percent": 100, "injectionType": 0,
+                          "interceptionCount": 1}}}))
+    res = PlanExecutor(mode="capped").execute(
+        plan, {"sales": sales, "dims": dims})
+    assert res.degraded and res.mode == "capped"
+    assert res.breaker["reason"] == "fatal"
+    # degraded capped results are unpadded (valid=None): compact() is id
+    assert res.compact().to_pydict() == ref.table.to_pydict()
+
+
+def test_degraded_result_visible_in_profile_text(tmp_path, _clean_faultinj):
+    sales, dims = _tables()
+    faultinj.install(_write_cfg(tmp_path, {"computeFaults": {
+        "plan.Sort": {"percent": 100, "injectionType": 0,
+                      "interceptionCount": 1}}}))
+    res = PlanExecutor().execute(_plan(), {"sales": sales, "dims": dims})
+    txt = res.profile_text()
+    assert "DEGRADED" in txt and "breaker open (fatal)" in txt
+
+
+def test_non_degraded_run_has_no_degraded_banner(tmp_path, _clean_faultinj):
+    """A device-tier success after an earlier trip (degrade="off" keeps
+    executing) must not claim CPU-tier completion in its profile."""
+    sales, dims = _tables()
+    plan = _plan()
+    faultinj.install(_write_cfg(tmp_path, {"computeFaults": {
+        "plan.Sort": {"percent": 100, "injectionType": 1,
+                      "interceptionCount": 3}}}))
+    ex = PlanExecutor(op_retries=0, degrade="off",
+                      health=_monitor(backoff_base_ms=1))
+    with pytest.raises(faultinj.DeviceAssertError):
+        ex.execute(plan, {"sales": sales, "dims": dims})
+    assert ex.health.breaker.state == OPEN    # tripped, but device-tier
+    faultinj.active().compute_rules["plan.Sort"].count = 0  # fault clears
+    res = ex.execute(plan, {"sales": sales, "dims": dims})
+    assert not res.degraded
+    assert "DEGRADED" not in res.profile_text()
+
+
+def test_degraded_tier_survives_active_session(tmp_path, _clean_faultinj):
+    """With a DeviceSession scoped to the execution, the degraded tier
+    must still complete: faultinj also shims MemoryBudget.acquire, and a
+    poisoned device fail-fasts EVERY intercepted call — the CPU tier
+    suppresses interception wholesale (faultinj.suppressed)."""
+    from spark_rapids_tpu.runtime import DeviceSession
+    sales, dims = _tables(n=500)
+    plan = _plan()
+    ref = PlanExecutor().execute(plan, {"sales": sales, "dims": dims})
+    faultinj.install(_write_cfg(tmp_path, {"computeFaults": {
+        "plan.HashJoin": {"percent": 100, "injectionType": 0,
+                          "interceptionCount": 1}}}))
+    with DeviceSession(device_limit_bytes=64 * 1024 * 1024,
+                       watchdog=False) as session:
+        res = PlanExecutor(session=session).execute(
+            plan, {"sales": sales, "dims": dims})
+    assert res.degraded and res.breaker["reason"] == "fatal"
+    assert res.table.to_pydict() == ref.table.to_pydict()
+
+
+def test_capped_degrade_preserves_retry_accounting(tmp_path, _clean_faultinj):
+    """Retries/backoff absorbed on the device path before a capped-tier
+    trip must survive into the degraded PlanResult."""
+    sales, dims = _tables()
+    plan = _plan()
+    ref = PlanExecutor().execute(plan, {"sales": sales, "dims": dims})
+    faultinj.install(_write_cfg(tmp_path, {"computeFaults": {
+        "plan.HashAggregate": {"percent": 100, "injectionType": 1}}}))
+    hm = _monitor(backoff_base_ms=1)
+    res = PlanExecutor(mode="capped", health=hm).execute(
+        plan, {"sales": sales, "dims": dims})
+    assert res.degraded and res.mode == "capped"
+    assert res.retries == 2 and res.backoff_ms > 0
+    assert res.compact().to_pydict() == ref.table.to_pydict()

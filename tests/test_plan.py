@@ -236,6 +236,52 @@ def test_capped_exhaustion_raises_not_corrupts():
         ex.execute(_plan(), {"sales": sales, "dims": dims})
 
 
+def test_capped_escalated_caps_remembered_across_executes():
+    """The second execute() of a plan starts from the escalated caps (per-
+    plan memo), not the originals — no re-paying the overflow ladder."""
+    sales, dims = _tables()
+    plan = _plan()
+    ex = PlanExecutor(mode="capped", caps={"row_cap": 64, "key_cap": 2},
+                      max_cap_attempts=8)
+    r1 = ex.execute(plan, {"sales": sales, "dims": dims})
+    assert r1.attempts > 1
+    r2 = ex.execute(plan, {"sales": sales, "dims": dims})
+    assert r2.attempts == 1                   # grown caps were remembered
+    assert r2.caps == r1.caps
+    assert r2.compact().to_pydict() == r1.compact().to_pydict()
+
+
+def test_capped_caps_memo_never_undersizes_larger_inputs():
+    """The memo skips re-learning, it must not UNDERSIZE: a plan learned
+    on small inputs still derives its defaults from the bigger inputs."""
+    small_sales, dims = _tables(n=64)
+    sales, _ = _tables(n=4000)
+    plan = _plan()
+    ex = PlanExecutor(mode="capped", max_cap_attempts=4)
+    ex.execute(plan, {"sales": small_sales, "dims": dims})
+    ref = PlanExecutor().execute(plan, {"sales": sales, "dims": dims})
+    res = ex.execute(plan, {"sales": sales, "dims": dims})
+    assert res.attempts == 1                  # floored at the new defaults
+    assert res.compact().to_pydict() == ref.table.to_pydict()
+
+
+def test_capped_bytes_metrics_track_input_shape():
+    """Re-running a cached plan with a previously-seen shape must report
+    THAT shape's bytes, not the most recent trace's."""
+    sales, dims = _tables(n=400)
+    big_sales, _ = _tables(n=800)
+    plan = _plan()
+    ex = PlanExecutor(mode="capped")
+    r_small = ex.execute(plan, {"sales": sales, "dims": dims})
+    ex.execute(plan, {"sales": big_sales, "dims": dims})
+    r_again = ex.execute(plan, {"sales": sales, "dims": dims})
+    scan = next(m for m in r_small.metrics.values() if m.kind == "Scan"
+                and "sales" in m.describe)
+    scan2 = next(m for m in r_again.metrics.values() if m.kind == "Scan"
+                 and "sales" in m.describe)
+    assert scan2.bytes_out == scan.bytes_out
+
+
 def test_capped_program_cache_reused():
     sales, dims = _tables()
     plan = _plan()
@@ -289,12 +335,31 @@ def test_injected_operator_fault_retries_capped(tmp_path, _clean_faultinj):
 
 
 def test_retry_exhaustion_reraises(tmp_path, _clean_faultinj):
+    # degrade="off": exhausted retries propagate (legacy failure behavior)
     sales, dims = _tables()
     faultinj.install(_write_cfg(tmp_path, {"computeFaults": {
         "plan.HashJoin": {"percent": 100, "injectionType": 1}}}))
     with pytest.raises(faultinj.DeviceAssertError):
-        PlanExecutor(op_retries=2).execute(
+        PlanExecutor(op_retries=2, degrade="off").execute(
             _plan(), {"sales": sales, "dims": dims})
+
+
+def test_retry_exhaustion_degrades_to_cpu(tmp_path, _clean_faultinj):
+    # default policy: a persistently failing operator classifies STICKY,
+    # trips the breaker, and the plan still completes on the CPU tier
+    sales, dims = _tables()
+    plan = _plan()
+    ref = PlanExecutor().execute(plan, {"sales": sales, "dims": dims})
+    faultinj.install(_write_cfg(tmp_path, {"computeFaults": {
+        "plan.HashJoin": {"percent": 100, "injectionType": 1}}}))
+    res = PlanExecutor(op_retries=2).execute(
+        plan, {"sales": sales, "dims": dims})
+    assert res.degraded
+    assert res.breaker["state"] == "open"
+    assert res.breaker["reason"] == "sticky"
+    assert res.table.to_pydict() == ref.table.to_pydict()
+    join = next(m for m in res.metrics.values() if m.kind == "HashJoin")
+    assert join.retries > 0 and join.degraded and join.backoff_ms > 0
 
 
 def test_fatal_fault_propagates_not_retried(tmp_path, _clean_faultinj):
@@ -302,10 +367,53 @@ def test_fatal_fault_propagates_not_retried(tmp_path, _clean_faultinj):
     faultinj.install(_write_cfg(tmp_path, {"computeFaults": {
         "plan.HashJoin": {"percent": 100, "injectionType": 0,
                           "interceptionCount": 1}}}))
-    # fatal poisons the device: no retry may run (stop-on-dead-device)
+    # fatal poisons the device: no device retry may run (stop-on-dead-
+    # device); with degradation off the fault propagates
     with pytest.raises(faultinj.DeviceFatalError):
-        PlanExecutor().execute(_plan(), {"sales": sales, "dims": dims})
+        PlanExecutor(degrade="off").execute(
+            _plan(), {"sales": sales, "dims": dims})
     assert faultinj.active().device_poisoned
+
+
+def test_poisoned_device_degrades_every_plan(tmp_path, _clean_faultinj):
+    """Poisoned-device case: after a fatal fault, EVERY intercepted device
+    call fails fast — a fresh executor (fresh breaker) must still classify
+    fatal on first touch and complete degraded, without device retries."""
+    sales, dims = _tables()
+    plan = _plan()
+    ref = PlanExecutor().execute(plan, {"sales": sales, "dims": dims})
+    faultinj.install(_write_cfg(tmp_path, {"computeFaults": {
+        "plan.HashJoin": {"percent": 100, "injectionType": 0,
+                          "interceptionCount": 1}}}))
+    res1 = PlanExecutor().execute(plan, {"sales": sales, "dims": dims})
+    assert res1.degraded and res1.breaker["reason"] == "fatal"
+    assert res1.table.to_pydict() == ref.table.to_pydict()
+    assert faultinj.active().device_poisoned
+    # new executor, same dead device: the very first plan-level point
+    # raises DeviceFatalError and the whole plan runs on the CPU tier
+    res2 = PlanExecutor().execute(plan, {"sales": sales, "dims": dims})
+    assert res2.degraded and res2.breaker["reason"] == "fatal"
+    assert res2.table.to_pydict() == ref.table.to_pydict()
+    join = next(m for m in res2.metrics.values() if m.kind == "HashJoin")
+    assert join.retries == 0          # no retry storms against a dead device
+
+
+def test_mid_plan_fault_attaches_partial_metrics(tmp_path, _clean_faultinj):
+    """A failed plan is still debuggable: the raised exception carries the
+    per-op metrics collected before the failure (err.plan_metrics)."""
+    sales, dims = _tables()
+    plan = _plan()
+    faultinj.install(_write_cfg(tmp_path, {"computeFaults": {
+        "plan.HashAggregate": {"percent": 100, "injectionType": 1}}}))
+    with pytest.raises(faultinj.DeviceAssertError) as ei:
+        PlanExecutor(degrade="off").execute(
+            plan, {"sales": sales, "dims": dims})
+    got = ei.value.plan_metrics
+    done_kinds = {m.kind for m in got.values()}
+    assert {"Scan", "Filter", "HashJoin", "Project"} <= done_kinds
+    assert "HashAggregate" not in done_kinds      # the op that failed
+    join = next(m for m in got.values() if m.kind == "HashJoin")
+    assert join.rows_out > 0 and join.wall_ms is not None
 
 
 # ---- distributed tier (Exchange + HashAggregate over the mesh) --------------

@@ -451,6 +451,35 @@ def test_with_retry_split_via_block_escalation(session):
         one.done()
 
 
+def test_with_retry_deep_split_depth(session):
+    """Split-depth regression: every batch bigger than one unit splits, so
+    a 128-unit batch cascades through 127 SplitAndRetryOOMs down to 128
+    unit leaves. The work queue is a deque (O(1) head replacement) — this
+    pins the depth-first order and completeness a quadratic list-head
+    rewrite also produced, at depths where the list was O(n²)."""
+    one = TaskActor(session, task_id=1).start()
+    try:
+        calls = []
+
+        def attempt(n):
+            calls.append(n)
+            if n > 1:
+                raise SplitAndRetryOOM(f"synthetic: batch of {n} too big")
+            r = session.device.acquire(1)
+            session.device.release(r)
+            return n
+
+        out = one.run(lambda: with_retry(
+            session.arbiter, attempt, 128,
+            split=lambda n: [n // 2, n - n // 2]), timeout=30)
+        assert out == [1] * 128
+        # depth-first, head-first: leftmost piece splits all the way down
+        assert calls[:8] == [128, 64, 32, 16, 8, 4, 2, 1]
+        assert len(calls) == 255          # 127 internal splits + 128 leaves
+    finally:
+        one.done()
+
+
 def test_retry_limit_hard_oom(session):
     # livelock watchdog (SparkResourceAdaptorJni.cpp:984-995): a task whose
     # retry/split loop never makes progress gets a hard OOM after the limit.
