@@ -161,20 +161,13 @@ def main(argv=None):
 
     # the same query through the plan engine's capped tier (generic
     # operator DAG; materializes each join frame instead of composing
-    # gather maps — the A/B that prices the declarative layer)
-    from spark_rapids_tpu.plan import PlanExecutor
-    from benchmarks.nds_plans import q3_inputs, q3_plan
-    ex = PlanExecutor(mode="capped",
+    # gather maps — the A/B that prices the declarative layer), optimizer
+    # off AND on: parity asserted, rows/bytes deltas on the JSONL rows
+    from benchmarks.nds_plans import q3_inputs, q3_plan, run_plan_variants
+    run_plan_variants("nds_q3_pipeline_plan", {"num_sales": n_sales},
+                      q3_plan(), q3_inputs(sales, dates, items),
+                      n_rows=n_sales, iters=args.iters,
                       caps=dict(row_cap=caps["row_cap1"], key_cap=4096))
-    plan, inputs = q3_plan(), q3_inputs(sales, dates, items)
-
-    def prun():
-        res = ex.execute(plan, inputs)
-        return [c.data for c in res.table.columns], res.valid
-
-    run_config("nds_q3_pipeline_plan", {"num_sales": n_sales}, prun, (),
-               n_rows=n_sales, iters=args.iters, jit=False,
-               impl="plan_capped")
 
 
 def jax_flatten(res):

@@ -217,18 +217,13 @@ def main(argv=None):
                jit=True,    # capped static-shape tier: one XLA program
                impl="capped_jit")
 
-    from spark_rapids_tpu.plan import PlanExecutor
-    from benchmarks.nds_plans import q5_inputs, q5_plan
-    ex = PlanExecutor(mode="capped", caps=dict(key_cap=2048))
-    plan, inputs = q5_plan(), q5_inputs(tabs, dates)
-
-    def prun():
-        res = ex.execute(plan, inputs)
-        return [c.data for c in res.table.columns], res.valid
-
-    run_config("nds_q5_pipeline_plan", {"num_rows": n_total}, prun, (),
-               n_rows=n_total, iters=args.iters, jit=False,
-               impl="plan_capped")
+    # plan tier, optimizer off AND on: parity asserted, rows/bytes deltas
+    # on the JSONL rows (docs/optimizer.md)
+    from benchmarks.nds_plans import q5_inputs, q5_plan, run_plan_variants
+    run_plan_variants("nds_q5_pipeline_plan", {"num_rows": n_total},
+                      q5_plan(), q5_inputs(tabs, dates),
+                      n_rows=n_total, iters=args.iters,
+                      caps=dict(key_cap=2048))
 
 
 if __name__ == "__main__":

@@ -204,20 +204,15 @@ def main(argv=None):
                jit=False,   # already jitted above
                impl="capped_jit")
 
-    from spark_rapids_tpu.plan import PlanExecutor
-    from benchmarks.nds_plans import q72_inputs, q72_plan
-    ex = PlanExecutor(mode="capped",
+    # plan tier, optimizer off AND on: parity asserted, rows/bytes deltas
+    # on the JSONL rows (docs/optimizer.md)
+    from benchmarks.nds_plans import (q72_inputs, q72_plan,
+                                      run_plan_variants)
+    run_plan_variants("nds_q72_pipeline_plan", {"num_sales": n},
+                      q72_plan(), q72_inputs(*tabs),
+                      n_rows=n, iters=args.iters,
                       caps=dict(row_cap=caps["row_cap"],
                                 key_cap=caps["key_cap"]))
-    plan, inputs = q72_plan(), q72_inputs(*tabs)
-
-    def prun():
-        res = ex.execute(plan, inputs)
-        return [c.data for c in res.table.columns], res.valid
-
-    run_config("nds_q72_pipeline_plan", {"num_sales": n}, prun, (),
-               n_rows=n, iters=args.iters, jit=False,
-               impl="plan_capped")
 
 
 if __name__ == "__main__":

@@ -112,6 +112,7 @@ def steady_state_ms(fn: Callable, args, iters: int, platform: str) -> float:
 def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
                 impl: str = None, retries: int = None,
                 faults_injected: int = None, degraded: bool = None,
+                optimizer: str = None, rules_fired: Dict = None,
                 **extra) -> Dict:
     """Build + print one bench JSONL record.
 
@@ -119,7 +120,13 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
     benchmarks/chaos_soak.py / docs/robustness.md): `retries` (fault
     re-runs the plan survived), `faults_injected` (faultinj count drained
     via get_and_reset_injected), `degraded` (result produced by the CPU
-    fallback tier after a breaker trip)."""
+    fallback tier after a breaker trip).
+
+    Optional optimizer fields (the plan-tier benches and the nightly
+    optimizer-parity stage record these, see docs/optimizer.md):
+    `optimizer` ("on"/"off" — which variant this row measured) and
+    `rules_fired` (rule -> rewrite count from PlanResult.optimizer), so
+    the JSONL history shows the before/after trajectory per rule."""
     rec = {"bench": bench, "axes": axes, "ms": round(ms, 3),
            "rows_per_s": round(n_rows / (ms * 1e-3))}
     if impl is not None:
@@ -130,6 +137,10 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
         rec["faults_injected"] = faults_injected
     if degraded is not None:
         rec["degraded"] = degraded
+    if optimizer is not None:
+        rec["optimizer"] = optimizer
+    if rules_fired is not None:
+        rec["rules_fired"] = rules_fired
     rec.update(extra)
     print(json.dumps(rec), flush=True)
     return rec
@@ -137,7 +148,7 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
 
 def run_config(bench: str, axes: Dict, fn: Callable, args, *, n_rows: int,
                iters: int = 10, jit: bool = True,
-               impl: str = None) -> Dict:
+               impl: str = None, **record_fields) -> Dict:
     """Time fn(*args) steady-state; returns + prints the result record.
 
     `jit=True` measures the op as deployed — one compiled XLA program
@@ -154,7 +165,7 @@ def run_config(bench: str, axes: Dict, fn: Callable, args, *, n_rows: int,
     out = fn(*args)
     sync(out)                           # compile + warmup
     ms = steady_state_ms(fn, args, iters, jax.default_backend())
-    extra = {}
+    extra = dict(record_fields)         # caller-supplied JSONL fields
     if getattr(steady_state_ms, "last_upper_bound", False):
         extra["ms_upper_bound"] = True  # sync round-trip folded in; see
         # steady_state_ms noise-floor fallback

@@ -143,6 +143,78 @@ def q72_plan():
              .build())
 
 
+# ---- optimized/unoptimized bench variants -----------------------------------
+
+def _sink_bytes_in(res) -> int:
+    """Bytes entering width-sensitive operators (join/aggregate/sort/
+    exchange) of the EXECUTED plan — the per-op metric column pruning is
+    expected to reduce (dead columns no longer cross the boundary)."""
+    from spark_rapids_tpu.plan import (Exchange, HashAggregate, HashJoin,
+                                       Sort, TopK)
+    total = 0
+    for node in res.plan.nodes:
+        if isinstance(node, (HashJoin, HashAggregate, Sort, TopK,
+                             Exchange)):
+            total += sum(res.metrics[c.label].bytes_out
+                         for c in node.children)
+    return total
+
+
+def run_plan_variants(bench: str, axes: dict, plan, inputs, *,
+                      n_rows: int, iters: int, caps: dict = None):
+    """Time the capped plan tier UNOPTIMIZED then OPTIMIZED, assert result
+    parity between the two, and record rows/bytes deltas + optimizer
+    fields on the JSONL rows (docs/optimizer.md). Shared by the four
+    bench_nds_q*.py plan configs and ci/nightly.sh's optimizer-parity
+    stage, so the bench numbers and the parity gate can never drift."""
+    from spark_rapids_tpu.plan import PlanExecutor
+    from benchmarks.common import run_config
+
+    results, totals, recs = {}, {}, []
+    for optimized in (False, True):
+        label = "on" if optimized else "off"
+        ex = PlanExecutor(mode="capped", caps=dict(caps or {}),
+                          optimize=optimized)
+        res = ex.execute(plan, inputs)          # correctness + metrics run
+        results[label] = res.compact().to_pydict()
+        totals[label] = {
+            "plan_rows_out": sum(m.rows_out for m in res.metrics.values()),
+            # the per-op frame sum double-counts zero-copy frames
+            # (inserted selects, capped-tier Filters), so also record the
+            # bytes ENTERING width-sensitive operators — the traffic that
+            # actually crosses a join/aggregate/sort materialization
+            # boundary, which is what column pruning shrinks
+            "plan_bytes_out": sum(m.bytes_out
+                                  for m in res.metrics.values()),
+            "plan_sink_bytes_in": _sink_bytes_in(res)}
+        extra = dict(totals[label])
+        rules = None
+        if optimized:
+            rules = res.optimizer["rules_fired"]
+            extra["pruned_columns"] = res.optimizer["pruned_columns"]
+            extra["fell_back"] = res.optimizer["fell_back"]
+            # the win the pruned columns bought, in per-op metric terms
+            extra["plan_bytes_saved"] = (totals["off"]["plan_bytes_out"]
+                                         - totals["on"]["plan_bytes_out"])
+            extra["plan_sink_bytes_saved"] = (
+                totals["off"]["plan_sink_bytes_in"]
+                - totals["on"]["plan_sink_bytes_in"])
+            extra["plan_rows_saved"] = (totals["off"]["plan_rows_out"]
+                                        - totals["on"]["plan_rows_out"])
+
+        def prun():
+            r = ex.execute(plan, inputs)
+            return [c.data for c in r.table.columns], r.valid
+
+        recs.append(run_config(
+            bench, dict(axes), prun, (), n_rows=n_rows, iters=iters,
+            jit=False, impl="plan_capped", optimizer=label,
+            rules_fired=rules, **extra))
+    assert results["on"] == results["off"], \
+        f"{bench}: optimizer changed the result"
+    return recs
+
+
 # ---- input bindings ---------------------------------------------------------
 
 def q3_inputs(sales, dates, items):

@@ -1,0 +1,84 @@
+"""Nightly optimizer-parity stage (ci/nightly.sh, docs/optimizer.md).
+
+Runs the four NDS plans through the capped plan tier with the rule-based
+optimizer OFF and ON (benchmarks/nds_plans.run_plan_variants — the same
+helper the bench_nds_q*.py plan configs use), asserting:
+
+- result parity per query (optimized == unoptimized, compacted rows);
+- nonzero pruned-column counts on q5 and q72 (the column-pruning rule's
+  contract on the shapes that carry dead columns);
+- a capped-tier jit-cache hit on a structurally REBUILT plan (the
+  fingerprint-keyed program cache: equivalent plans built independently
+  share one compiled XLA program).
+
+Emits one JSONL row per (query, optimizer) variant with `optimizer`,
+`rules_fired`, `pruned_columns` and plan rows/bytes deltas, plus one
+`optimizer_fingerprint_reuse` row recording the cache hit — the BENCH
+history shows the before/after trajectory across revisions.
+"""
+import sys
+
+sys.path.insert(0, ".")
+
+from benchmarks.common import emit_record, parse_args        # noqa: E402
+from benchmarks.nds_plans import (q3_inputs, q3_plan,        # noqa: E402
+                                  q5_inputs, q5_plan, q23_inputs, q23_plan,
+                                  q72_inputs, q72_plan, run_plan_variants)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    n = max(int(100_000 * args.scale), 4000)
+    iters = min(args.iters, 3)      # parity gate first, timing second
+
+    from benchmarks.bench_nds_q3 import build_tables as bt3
+    from benchmarks.bench_nds_q5 import build_tables as bt5
+    from benchmarks.bench_nds_q23 import build_tables as bt23
+    from benchmarks.bench_nds_q72 import build_tables as bt72
+
+    cases = {
+        "q3": (q3_plan(), q3_inputs(*bt3(n, seed=7)), None),
+        "q5": (q5_plan(), q5_inputs(*bt5(n, seed=3)),
+               dict(key_cap=2048)),
+        "q23": (q23_plan(), q23_inputs(*bt23(n, seed=11)),
+                dict(key_cap=8192, row_cap=n)),
+        "q72": (q72_plan(), q72_inputs(*bt72(n, seed=5)), None),
+    }
+    on_rows = {}
+    for name, (plan, inputs, caps) in cases.items():
+        n_rows = sum(t.num_rows for t in inputs.values())
+        recs = run_plan_variants(f"optimizer_parity_{name}",
+                                 {"num_rows": n_rows}, plan, inputs,
+                                 n_rows=n_rows, iters=iters, caps=caps)
+        on = on_rows[name] = next(r for r in recs
+                                  if r["optimizer"] == "on")
+        assert not on["fell_back"], f"{name}: optimizer fell back"
+        assert on["rules_fired"], f"{name}: optimizer fired no rules"
+    for name in ("q5", "q72"):
+        on = on_rows[name]
+        assert on["pruned_columns"] > 0, \
+            f"{name}: expected pruned columns, got {on['pruned_columns']}"
+        # pruning must show up in the per-op bytes metrics: fewer bytes
+        # crossing the join/aggregate/sort materialization boundaries
+        assert on["plan_sink_bytes_saved"] > 0, \
+            f"{name}: pruning saved no sink bytes ({on})"
+
+    # fingerprint-keyed program reuse: a structurally REBUILT q3 plan must
+    # hit the compiled-program cache (no re-trace), recorded in the JSONL
+    from spark_rapids_tpu.plan import PlanExecutor
+    _, inputs, _ = cases["q3"]
+    ex = PlanExecutor(mode="capped")
+    ex.execute(q3_plan(), inputs)
+    n_programs = len(ex._jit_cache)
+    res = ex.execute(q3_plan(), inputs)          # independently rebuilt
+    assert res.jit_cache_hits >= 1, "rebuilt plan missed the jit cache"
+    assert len(ex._jit_cache) == n_programs, "rebuilt plan re-traced"
+    n_rows = sum(t.num_rows for t in inputs.values())
+    emit_record("optimizer_fingerprint_reuse", {"num_rows": n_rows},
+                res.wall_ms, n_rows, impl="plan_capped", optimizer="on",
+                jit_cache_hits=res.jit_cache_hits)
+    print("optimizer parity OK", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,11 @@ python benchmarks/run_all.py --scale 0.01 --iters 5 --cpu
 # run, non-zero retry/degraded counts, and breaker recovery via
 # reset_device(); emits retries/faults_injected/degraded JSONL fields
 JAX_PLATFORMS=cpu python benchmarks/chaos_soak.py --scale 0.2 --cpu
+# optimizer parity (docs/optimizer.md): the four NDS plans, capped tier,
+# optimizer off vs on — asserts result parity, nonzero pruned-column
+# counts on q5/q72, and a fingerprint-keyed jit-cache hit on a rebuilt
+# plan; emits optimizer/rules_fired JSONL fields
+JAX_PLATFORMS=cpu python benchmarks/optimizer_parity.py --scale 0.1 --cpu
 ./ci/fuzz-test.sh
 ./ci/sanitizer.sh
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

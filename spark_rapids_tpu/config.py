@@ -21,6 +21,7 @@ equivalents, all read at use time (not import time) so tests can monkeypatch:
 | SPARK_RAPIDS_TPU_BREAKER_STICKY_WINDOW_S | 60 | sticky-detection window |
 | SPARK_RAPIDS_TPU_BREAKER_COOLDOWN_S | 30 | open→half_open self-arm delay (0 = only reset_device) |
 | SPARK_RAPIDS_TPU_BREAKER_DEGRADE | cpu  | cpu (finish tripped plans on the CPU tier) / off |
+| SPARK_RAPIDS_TPU_OPTIMIZER       | on   | rule-based plan optimizer (plan/optimizer.py): on/off |
 
 The SPARK_RAPIDS_TPU_BREAKER_* numeric knobs are snapshotted when a
 `DeviceHealthMonitor` is constructed (one policy per monitor lifetime —
@@ -119,6 +120,18 @@ def breaker_degrade() -> str:
         raise ValueError(
             f"SPARK_RAPIDS_TPU_BREAKER_DEGRADE={v!r}: expected cpu or off")
     return v
+
+
+def optimizer_enabled() -> bool:
+    """Rule-based plan optimizer (plan/optimizer.py), run inside
+    PlanExecutor.execute() before tier dispatch. "on" (default) or "off";
+    same strict-typo policy as the kernel selectors — a typo must not
+    silently change which plan shape executes."""
+    v = os.environ.get("SPARK_RAPIDS_TPU_OPTIMIZER", "on")
+    if v not in ("on", "off"):
+        raise ValueError(
+            f"SPARK_RAPIDS_TPU_OPTIMIZER={v!r}: expected on or off")
+    return v == "on"
 
 
 def groupby_kernel() -> str:

@@ -15,6 +15,12 @@ concerns live in ONE executor:
   mini-language predicates/projections are written in.
 - `builder`: fluent, validating construction (`PlanBuilder`); schema and
   reference errors surface at build time as `PlanValidationError`.
+- `optimizer`: Catalyst-style rule pipeline (column pruning, predicate/
+  limit pushdown, constant folding, Filter+Project fusion into
+  `FusedSelect`, Sort+Limit fusion into `TopK`, join build-side
+  selection) run to fixpoint inside `execute()` before tier dispatch,
+  plus the canonical `plan_fingerprint` the executor keys its compiled-
+  program and caps memos by (docs/optimizer.md).
 - `executor`: walks the DAG composing the public `ops` kernels (eager tier)
   or traces the whole plan into ONE capped XLA program (jit tier) with
   geometric cap escalation via `parallel.autoretry` at plan granularity;
@@ -30,16 +36,19 @@ See docs/plan.md for the operator contract and how a JVM/plugin front-end
 targets this layer.
 """
 from .expr import col, lit, scalar_max, scalar_min, scalar_sum, Expr
-from .nodes import (Exchange, Filter, HashAggregate, HashJoin, Limit,
-                    PlanNode, Project, Scan, Sort, Union)
+from .nodes import (Exchange, Filter, FusedSelect, HashAggregate, HashJoin,
+                    Limit, PlanNode, Project, Scan, Sort, TopK, Union)
 from .builder import Plan, PlanBuilder, PlanValidationError
 from .executor import PlanExecutor, PlanResult
 from .metrics import OperatorMetrics
+from .optimizer import OptimizeReport, optimize, plan_fingerprint
 
 __all__ = [
     "col", "lit", "scalar_max", "scalar_min", "scalar_sum", "Expr",
-    "Scan", "Filter", "Project", "HashJoin", "HashAggregate", "Sort",
-    "Exchange", "Limit", "Union", "PlanNode",
+    "Scan", "Filter", "Project", "FusedSelect", "HashJoin",
+    "HashAggregate", "Sort", "TopK", "Exchange", "Limit", "Union",
+    "PlanNode",
     "Plan", "PlanBuilder", "PlanValidationError",
     "PlanExecutor", "PlanResult", "OperatorMetrics",
+    "optimize", "plan_fingerprint", "OptimizeReport",
 ]

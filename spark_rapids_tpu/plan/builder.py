@@ -80,7 +80,9 @@ class Plan:
                     raise PlanValidationError(
                         f"{node.label}: bound table schema {list(schema)} "
                         f"does not match declared {list(node.schema)}")
-                out[id(node)] = schema
+                # the optimizer's pruned projection narrows the OUTPUT; the
+                # declared/bound cross-check above ran on the full schema
+                out[id(node)] = node.apply_projection(schema)
                 continue
             child_schemas = []
             ok = True
@@ -100,6 +102,19 @@ class Plan:
     @property
     def input_names(self) -> List[str]:
         return [s.source for s in self.scans]
+
+    @property
+    def fingerprint(self) -> str:
+        """Canonical structural hash (node kinds, parameters, exprs,
+        declared schemas, DAG shape). Two independently built plans with
+        the same structure share one fingerprint — the executor keys its
+        compiled-program and caps memos on it, so equivalent plans reuse
+        compiled XLA programs (see plan/optimizer.py)."""
+        fp = self.__dict__.get("_fingerprint")
+        if fp is None:
+            from .optimizer import plan_fingerprint
+            fp = self.__dict__["_fingerprint"] = plan_fingerprint(self)
+        return fp
 
     # ---- explain ----------------------------------------------------------
     def explain(self) -> str:
@@ -193,8 +208,13 @@ class PlanBuilder:
     """Entry point: `scan()` leaves, then chain on the returned Rel."""
 
     def scan(self, source: str,
-             schema: Optional[Sequence[str]] = None) -> Rel:
-        return Rel(Scan(source, None if schema is None else tuple(schema)))
+             schema: Optional[Sequence[str]] = None,
+             est_rows: Optional[int] = None) -> Rel:
+        """`est_rows` is an optional cardinality hint threaded to the
+        optimizer's build-side selection; bound tables' actual row counts
+        take precedence at execute()."""
+        return Rel(Scan(source, None if schema is None else tuple(schema),
+                        est_rows=est_rows))
 
     @staticmethod
     def union(rels: Sequence[Rel]) -> Rel:

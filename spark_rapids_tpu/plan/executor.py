@@ -1,5 +1,12 @@
 """Plan executor: walks the operator DAG and runs it on one of three tiers.
 
+Before tier dispatch, `execute()` runs the rule-based logical optimizer
+(`plan/optimizer.py`, docs/optimizer.md) over the bound plan — column
+pruning, predicate/limit pushdown, constant folding, Filter+Project
+fusion, join build-side selection — and executes the rewritten DAG;
+`SPARK_RAPIDS_TPU_OPTIMIZER=off` or `PlanExecutor(optimize=False)`
+disables it. `PlanResult.optimizer` reports what fired.
+
 - `mode="eager"`: per-operator dispatch through the public `ops` kernels —
   every operator gets its own wall-clock, rows/bytes metrics, a
   `utils.tracing` range, a plan-level faultinj interception point, and a
@@ -10,9 +17,11 @@
   overrides take precedence). A too-small cap raises the overflow flag and
   `parallel.autoretry.auto_retry_overflow` grows every cap geometrically
   and re-traces — SplitAndRetry at PLAN granularity, not per-call. The
-  compiled program is cached per (plan, caps, input shapes) and the final
-  capacities are memoized per plan, so escalated caps are remembered for
-  the rest of the job (later execute() calls start from the grown caps).
+  compiled program is cached per (plan FINGERPRINT, caps, input
+  shapes+names) and the final capacities are memoized per fingerprint, so
+  escalated caps are remembered for the rest of the job AND structurally
+  identical plans built independently share compiled programs
+  (`optimizer.plan_fingerprint`).
 - distributed (eager tier only — the constructor rejects a mesh with
   mode="capped"): when a device `mesh` is given, a `HashAggregate` sitting
   on an `Exchange` runs on the `parallel.relational` tier (partial agg →
@@ -55,9 +64,9 @@ from .. import dtypes
 from ..columnar import Column, Table
 from .builder import Plan
 from .metrics import OperatorMetrics, render_profile
-from .nodes import (Exchange, Filter, HashAggregate, HashJoin, Limit,
-                    PlanNode, PlanValidationError, Project, Scan, Sort,
-                    Union)
+from .nodes import (Exchange, Filter, FusedSelect, HashAggregate, HashJoin,
+                    Limit, PlanNode, PlanValidationError, Project, Scan,
+                    Sort, TopK, Union)
 from .expr import ColumnRef
 
 # The device-fault surface the executor turns into policy (runtime/health):
@@ -80,28 +89,9 @@ def _ops():
     return ops
 
 
-class _LruDict(dict):
-    """Bounded cache: lookups refresh recency, inserts evict the oldest.
-    Executors live for a whole job while front-ends may hand them a fresh
-    Plan per query — unbounded program/caps caches would pin every plan's
-    node graph forever."""
-
-    def __init__(self, maxsize: int):
-        super().__init__()
-        self.maxsize = maxsize
-
-    def get(self, key, default=None):
-        if key in self:
-            val = super().pop(key)
-            super().__setitem__(key, val)   # re-insert = most recent
-            return val
-        return default
-
-    def __setitem__(self, key, value):
-        super().pop(key, None)
-        super().__setitem__(key, value)
-        while len(self) > self.maxsize:
-            del self[next(iter(self))]
+# one bounded-cache definition for the whole engine (utils/lru.py): the
+# executor's program/caps memos and the optimizer cache share it
+from ..utils.lru import LruDict as _LruDict
 
 
 def _cpu_device():
@@ -170,8 +160,11 @@ class PlanResult:
                  caps: Optional[Dict[str, int]] = None, retries: int = 0,
                  degraded: bool = False,
                  breaker: Optional[Dict] = None,
-                 backoff_ms: float = 0.0):
-        self.plan = plan
+                 backoff_ms: float = 0.0,
+                 jit_cache_hits: int = 0):
+        self.plan = plan              # the EXECUTED plan (optimized form
+        #                               when the optimizer ran; metric
+        #                               labels refer to its nodes)
         self.table = table
         self.valid = valid
         self.metrics = metrics
@@ -183,6 +176,10 @@ class PlanResult:
         self.degraded = degraded      # finished on the CPU tier (breaker trip)
         self.breaker = breaker        # {"state","trips","reason","error"}
         self.backoff_ms = backoff_ms  # total retry backoff across the plan
+        self.jit_cache_hits = jit_cache_hits  # capped-tier fingerprint-keyed
+        #                               compiled-program reuses this execute
+        self.optimizer = None         # OptimizeReport.to_dict() when the
+        #                               optimizer ran (set by execute())
 
     def compact(self) -> Table:
         """Live rows only (identity in the eager tier)."""
@@ -200,7 +197,9 @@ class PlanResult:
         return render_profile(list(self.metrics.values()),
                               plan_wall_ms=self.wall_ms,
                               attempts=self.attempts, caps=self.caps,
-                              degraded=self.degraded, breaker=self.breaker)
+                              degraded=self.degraded, breaker=self.breaker,
+                              optimizer=self.optimizer,
+                              jit_cache_hits=self.jit_cache_hits)
 
 
 class _CappedRel:
@@ -225,7 +224,8 @@ class PlanExecutor:
                  session=None,
                  block_per_op: bool = True,
                  health=None,
-                 degrade: Optional[str] = None):
+                 degrade: Optional[str] = None,
+                 optimize: Optional[bool] = None):
         if mode not in ("eager", "capped"):
             raise ValueError(f"unknown executor mode {mode!r}")
         if mesh is not None and mode != "eager":
@@ -249,34 +249,99 @@ class PlanExecutor:
         if self.degrade not in ("cpu", "off"):
             raise ValueError(f"unknown degrade policy {self.degrade!r} "
                              "(expected cpu or off)")
+        # rule-based logical optimizer (plan/optimizer.py): on by default,
+        # SPARK_RAPIDS_TPU_OPTIMIZER=off or optimize=False disables
+        self.optimize = (config.optimizer_enabled() if optimize is None
+                         else bool(optimize))
+        self._opt_cache = _LruDict(64)  # (root, bound sig) -> (plan, schemas,
+        #                                 report): one rewrite per binding
         self._jit_cache: Dict[Tuple, Tuple[Callable, Dict]] = _LruDict(64)
-        # escalated capacities survive per plan (keyed by the root node
-        # object — identity hash, and the strong ref pins it so a recycled
-        # id() can never alias a dead plan): the next execute() starts from
-        # the grown caps instead of re-paying the whole overflow ladder
-        self._caps_memo: Dict[PlanNode, Dict[str, int]] = _LruDict(256)
+        # escalated capacities survive per plan STRUCTURE (keyed by the
+        # canonical fingerprint — optimizer.plan_fingerprint), so the next
+        # execute() of this plan, or of an equivalent plan built
+        # independently, starts from the grown caps instead of re-paying
+        # the whole overflow ladder
+        self._caps_memo: Dict[str, Dict[str, int]] = _LruDict(256)
 
     # ---- entry point ------------------------------------------------------
     def execute(self, plan: Plan, inputs: Dict[str, Table]) -> PlanResult:
         missing = [s for s in plan.input_names if s not in inputs]
         if missing:
             raise PlanValidationError(f"unbound plan input(s) {missing}")
-        # full validation against the bound tables' actual schemas
-        schemas = plan.resolve_schemas(
-            {name: t.names for name, t in inputs.items()})
+        # full validation against the bound tables' actual schemas —
+        # authored-plan errors surface against authored labels, BEFORE any
+        # optimizer rewrite renames nodes
+        bound = {name: tuple(t.names) for name, t in inputs.items()}
+        schemas = plan.resolve_schemas(bound)
+        report = None
+        if self.optimize:
+            plan, schemas, report = self._optimized(plan, inputs, bound)
         if self.session is not None:
             from ..runtime.admission import active_session
             with active_session(self.session):
-                return self._execute(plan, inputs, schemas)
-        return self._execute(plan, inputs, schemas)
+                res = self._execute(plan, inputs, schemas)
+        else:
+            res = self._execute(plan, inputs, schemas)
+        if report is not None:
+            res.optimizer = report.to_dict()
+        return res
+
+    def _optimized(self, plan, inputs, bound):
+        """Rewrite `plan` through the rule pipeline, once per (plan,
+        binding): repeat executions reuse the cached rewrite (and through
+        the fingerprint-keyed program cache, the compiled XLA program)."""
+        from .optimizer import optimize as run_optimizer
+        # fp reductions are not reorder-exact: float columns anywhere in
+        # the inputs disable the row-reordering build_side rule. The flag
+        # is part of the cache KEY — a rewrite computed from integer
+        # inputs must not be served to a float binding of the same
+        # names/shapes (the gate would be bypassed by the cache hit)
+        floats = any(
+            np.issubdtype(np.dtype(c.dtype.storage_dtype()), np.floating)
+            for t in inputs.values() for c in t.columns)
+        key = (plan.root, tuple(sorted(bound.items())),
+               tuple(sorted((n, t.num_rows) for n, t in inputs.items())),
+               floats)
+        hit = self._opt_cache.get(key)
+        if hit is None:
+            opt, report = run_optimizer(
+                plan, bound, {n: t.num_rows for n, t in inputs.items()},
+                float_inputs=floats)
+            hit = (opt, opt.resolve_schemas(bound), report)
+            self._opt_cache[key] = hit
+        return hit
 
     def _execute(self, plan, inputs, schemas):
         if self.mode == "eager":
             return self._execute_eager(plan, inputs, schemas)
         return self._execute_capped(plan, inputs, schemas)
 
-    def explain(self, plan: Plan) -> str:
-        return plan.explain()
+    def explain(self, plan: Plan, optimized: bool = False,
+                inputs: Optional[Dict[str, Table]] = None) -> str:
+        """The authored operator tree; with `optimized=True`, the authored
+        AND optimizer-rewritten trees plus the per-rule rewrite summary.
+        Pass `inputs` to render the EXACT rewrite execute() runs for that
+        binding (bound schemas/rows + the float build_side gate); without
+        them the rewrite uses declared schemas and est_rows hints only,
+        so bind-time pruning/estimates may differ."""
+        if not optimized:
+            return plan.explain()
+        if inputs is not None:
+            if not self.optimize:
+                # "EXACT rewrite execute() runs" — which, for a disabled
+                # optimizer, is no rewrite at all
+                return (plan.explain() + "\n\noptimizer: disabled for "
+                        "this executor (optimize=False / "
+                        "SPARK_RAPIDS_TPU_OPTIMIZER=off) — the authored "
+                        "plan executes verbatim")
+            bound = {name: tuple(t.names) for name, t in inputs.items()}
+            plan.resolve_schemas(bound)         # validate the binding
+            opt, _, report = self._optimized(plan, inputs, bound)
+            return "\n".join(["== authored ==", plan.explain(), "",
+                              "== optimized ==", opt.explain(), "",
+                              report.summary()])
+        from .optimizer import explain_optimized
+        return explain_optimized(plan)
 
     # ---- faultinj ---------------------------------------------------------
     @staticmethod
@@ -499,11 +564,27 @@ class PlanExecutor:
                          allow_mesh: bool = True) -> Table:
         ops = _ops()
         if isinstance(node, Scan):
-            return inputs[node.source]
+            t = inputs[node.source]
+            if node.projection is not None:
+                # pruned scan: unused columns never enter the plan
+                t = t.select(list(node.projection))
+            return t
         if isinstance(node, Filter):
             (t,) = childs
             mask = node.predicate.evaluate(t)
             return ops.apply_boolean_mask(t, mask)
+        if isinstance(node, FusedSelect):
+            # fused Filter+Project: gather ONLY the projection-referenced
+            # columns through the mask, then project — one pass, instead of
+            # materializing the full filtered child first
+            (t,) = childs
+            mask = node.predicate.evaluate(t)
+            needed = sorted(set().union(
+                *(e.references() for _, e in node.exprs)))
+            if not needed:              # all-literal projection: any column
+                needed = [t.names[0]]   # carries the filtered row count
+            ft = ops.apply_boolean_mask(t.select(needed), mask)
+            return self._project(ft, node)
         if isinstance(node, Project):
             (t,) = childs
             return self._project(t, node)
@@ -537,6 +618,11 @@ class PlanExecutor:
             (t,) = childs
             return ops.sort_table(t, key_names=list(node.keys),
                                   ascending=list(node.ascending))
+        if isinstance(node, TopK):
+            (t,) = childs
+            t = ops.sort_table(t, key_names=list(node.keys),
+                               ascending=list(node.ascending))
+            return ops.slice_table(t, 0, min(node.n, t.num_rows))
         if isinstance(node, Limit):
             (t,) = childs
             return ops.slice_table(t, 0, min(node.n, t.num_rows))
@@ -670,21 +756,24 @@ class PlanExecutor:
         the largest input) plus one per-node entry for each node-level
         override — those ride the SAME escalation dict, so an undersized
         override grows geometrically like everything else instead of
-        livelocking through identical attempts."""
+        livelocking through identical attempts. Per-node entries key on
+        the toposort INDEX (stable across fingerprint-equal plans, whose
+        labels differ), so the caps memo and program cache stay shared
+        when the same plan is rebuilt."""
         caps = dict(self.caps)
         max_rows = max((t.num_rows for t in inputs.values()), default=1)
         needs_row = needs_key = False
-        for n in plan.nodes:
+        for i, n in enumerate(plan.nodes):
             if isinstance(n, HashJoin) and n.how == "inner":
                 if n.row_cap is None:
                     needs_row = True
                 else:
-                    caps[f"row_cap:{n.label}"] = n.row_cap
+                    caps[f"row_cap:{i}"] = n.row_cap
             elif isinstance(n, HashAggregate) and n.keys:
                 if n.key_cap is None:
                     needs_key = True
                 else:
-                    caps[f"key_cap:{n.label}"] = n.key_cap
+                    caps[f"key_cap:{i}"] = n.key_cap
         if needs_row:
             caps.setdefault("row_cap", max(max_rows, 1))
         if needs_key:
@@ -692,8 +781,8 @@ class PlanExecutor:
         return caps
 
     @staticmethod
-    def _node_cap(caps: Dict[str, int], which: str, node: PlanNode) -> int:
-        return caps.get(f"{which}:{node.label}") or caps[which]
+    def _node_cap(caps: Dict[str, int], which: str, idx: int) -> int:
+        return caps.get(f"{which}:{idx}") or caps[which]
 
     def _execute_capped(self, plan, inputs, schemas) -> PlanResult:
         from ..parallel.autoretry import auto_retry_overflow
@@ -701,11 +790,15 @@ class PlanExecutor:
         # plan already escalated to: the memo must never UNDERSIZE a run on
         # larger inputs than it was learned on (only skip re-learning)
         caps = self._default_caps(plan, inputs)
-        for k, v in (self._caps_memo.get(plan.root) or {}).items():
+        fp = plan.fingerprint        # canonical structural hash: equivalent
+        #                              plans built independently share the
+        #                              caps memo and compiled programs
+        for k, v in (self._caps_memo.get(fp) or {}).items():
             caps[k] = max(caps.get(k, 0), v)
         t0 = time.perf_counter()
         attempts = 0
-        bytes_map: Dict[str, int] = {}
+        cache_hits = 0
+        bytes_map: Dict[int, int] = {}
         last_caps = dict(caps)
         self.health.start_plan_attempt()
         if self.degrade != "off" and not self.health.admit():
@@ -713,7 +806,7 @@ class PlanExecutor:
                                           start=0, t_plan0=t0, mode="capped")
 
         def run(**caps_now):
-            nonlocal attempts
+            nonlocal attempts, cache_hits
             attempts += 1
             last_caps.clear()
             last_caps.update(caps_now)
@@ -721,12 +814,15 @@ class PlanExecutor:
             # cache-hit runs where the op-level shims never re-trace
             for node in plan.nodes:
                 self._faultinj_point(node)
-            # shapes in the key: jax retraces per input shape anyway, and a
-            # per-shape entry keeps each bytes_map true to ITS trace (a
-            # shared dict would serve one shape's bytes to another's run)
-            fn, bm = self._jitted_capped(
+            # shapes AND names in the key: jax retraces per input shape
+            # anyway, a per-shape entry keeps each bytes_map true to ITS
+            # trace, and the names guard fingerprint-shared undeclared
+            # scans bound to differently-named tables
+            fn, bm, hit = self._jitted_capped(
                 plan, schemas, caps_now,
-                tuple(sorted((n, t.num_rows) for n, t in inputs.items())))
+                tuple(sorted((n, tuple(t.names), t.num_rows)
+                             for n, t in inputs.items())))
+            cache_hits += hit
             out = fn(dict(inputs))
             bytes_map.clear()
             bytes_map.update(bm)    # bm fills during the first trace
@@ -741,7 +837,7 @@ class PlanExecutor:
                     auto_retry_overflow(run, caps, self.max_cap_attempts)
                 if retries:
                     self.health.record_success("plan")
-                self._caps_memo[plan.root] = dict(final_caps)
+                self._caps_memo[fp] = dict(final_caps)
                 break
             except _fault_surface() as err:
                 # failures are plan-granular here (one XLA program), so the
@@ -772,8 +868,11 @@ class PlanExecutor:
                      for k, (a, b) in zip(counts.keys(),
                                           np.asarray(list(counts.values()),
                                                      dtype=np.int64))}
-        for node in plan.nodes:
-            rows_in, rows_out = counts_np[node.label]
+        for i, node in enumerate(plan.nodes):
+            # counts/bytes key on the toposort INDEX, not the label: a
+            # fingerprint-shared program was traced over an equivalent
+            # plan whose node labels differ, but its toposort lines up 1:1
+            rows_in, rows_out = counts_np[i]
             uses_cap = (isinstance(node, HashJoin) and node.how == "inner") \
                 or (isinstance(node, HashAggregate) and node.keys)
             # retries are plan-granular in this tier (one XLA program) and
@@ -782,55 +881,61 @@ class PlanExecutor:
             metrics[node.label] = OperatorMetrics(
                 label=node.label, kind=node.kind, describe=node.describe(),
                 rows_in=rows_in, rows_out=rows_out,
-                bytes_out=bytes_map.get(node.label, 0),
+                bytes_out=bytes_map.get(i, 0),
                 escalations=escal if uses_cap else 0)
         return PlanResult(plan, table, valid, metrics, "capped", wall,
                           attempts=attempts, caps=final_caps,
                           retries=retries,
                           breaker=self._breaker_snapshot(),
-                          backoff_ms=backoff_total)
+                          backoff_ms=backoff_total,
+                          jit_cache_hits=cache_hits)
 
     def _jitted_capped(self, plan, schemas, caps, input_key):
-        # the root NODE is the key (identity hash, strong ref — same scheme
-        # as _caps_memo), so a recycled id() can never alias a dead plan
-        key = (plan.root, tuple(sorted(caps.items())), input_key)
+        # the canonical FINGERPRINT is the key: structurally equivalent
+        # plans built independently (same kinds/exprs/schemas/DAG shape)
+        # share one compiled program instead of re-tracing. Returns
+        # (jitted_fn, bytes_map, cache_hit).
+        key = (plan.fingerprint, tuple(sorted(caps.items())), input_key)
         hit = self._jit_cache.get(key)
         if hit is not None:
-            return hit
-        bytes_map: Dict[str, int] = {}
+            return hit[0], hit[1], True
+        bytes_map: Dict[int, int] = {}
 
         def fn(tables: Dict[str, Table]):
             return self._run_capped(plan, schemas, caps, tables, bytes_map)
 
         jitted = jax.jit(fn)
         self._jit_cache[key] = (jitted, bytes_map)
-        return jitted, bytes_map
+        return jitted, bytes_map, False
 
     def _run_capped(self, plan, schemas, caps, tables, bytes_map):
         from ..runtime.admission import operand_nbytes
         rels: Dict[int, _CappedRel] = {}
-        counts: Dict[str, Tuple] = {}
+        # counts/bytes key on the toposort index: stable across
+        # fingerprint-equal plans, whose labels differ (see _jitted_capped)
+        counts: Dict[int, Tuple] = {}
         overflow = jnp.asarray(False)
-        for node in plan.nodes:
+        for i, node in enumerate(plan.nodes):
             childs = [rels[id(c)] for c in node.children]
-            rel, ovf = self._exec_capped_node(node, childs, tables, schemas,
-                                              caps)
+            rel, ovf = self._exec_capped_node(node, i, childs, tables,
+                                              schemas, caps)
             if ovf is not None:
                 overflow = overflow | ovf
-            bytes_map[node.label] = operand_nbytes(rel.table)
+            bytes_map[i] = operand_nbytes(rel.table)
             rows_in = sum((jnp.sum(c.alive.astype(jnp.int64))
                            for c in childs), start=jnp.int64(0))
-            counts[node.label] = (rows_in,
-                                  jnp.sum(rel.alive.astype(jnp.int64)))
+            counts[i] = (rows_in, jnp.sum(rel.alive.astype(jnp.int64)))
             rels[id(node)] = rel
         root = rels[id(plan.root)]
         return root.table, root.alive, counts, overflow
 
-    def _exec_capped_node(self, node, childs: List[_CappedRel], tables,
-                          schemas, caps):
+    def _exec_capped_node(self, node, idx: int, childs: List[_CappedRel],
+                          tables, schemas, caps):
         ops = _ops()
         if isinstance(node, Scan):
             t = tables[node.source]
+            if node.projection is not None:
+                t = t.select(list(node.projection))
             return _CappedRel(t, jnp.ones((t.num_rows,), bool)), None
         if isinstance(node, Filter):
             (c,) = childs
@@ -838,6 +943,15 @@ class PlanExecutor:
             # compaction, dead rows stay and stay dead
             mask = node.predicate.evaluate(c.table, c.alive)
             return _CappedRel(c.table, c.alive & mask), None
+        if isinstance(node, FusedSelect):
+            # filter-then-project over the padded frame: the predicate ANDs
+            # into alive and the projection evaluates under the new mask
+            # (scalar aggregates reduce over the filtered live rows)
+            (c,) = childs
+            mask = node.predicate.evaluate(c.table, c.alive)
+            alive = c.alive & mask
+            return _CappedRel(self._project(c.table, node, alive),
+                              alive), None
         if isinstance(node, Project):
             (c,) = childs
             return _CappedRel(self._project(c.table, node, c.alive),
@@ -847,7 +961,7 @@ class PlanExecutor:
             lkeys = [l.table[k] for k in node.left_keys]
             rkeys = [r.table[k] for k in node.right_keys]
             if node.how == "inner":
-                row_cap = self._node_cap(caps, "row_cap", node)
+                row_cap = self._node_cap(caps, "row_cap", idx)
                 lm, rm, valid, ovf = ops.inner_join_capped(
                     lkeys, rkeys, row_cap=row_cap, lalive=l.alive,
                     ralive=r.alive)
@@ -868,7 +982,7 @@ class PlanExecutor:
             if not node.keys:
                 t = self._global_aggregate(c.table, node, alive=c.alive)
                 return _CappedRel(t, jnp.ones((1,), bool)), None
-            key_cap = self._node_cap(caps, "key_cap", node)
+            key_cap = self._node_cap(caps, "key_cap", idx)
             agg, valid, ovf = ops.groupby_aggregate_capped(
                 c.table, list(node.keys), [(cn, o) for cn, o, _ in node.aggs],
                 key_cap=key_cap, alive=c.alive)
@@ -880,6 +994,15 @@ class PlanExecutor:
                 c.table, key_names=list(node.keys),
                 ascending=list(node.ascending), alive=c.alive)
             return _CappedRel(t, alive), None
+        if isinstance(node, TopK):
+            # fused Sort+Limit: dead rows sink in the capped sort, then the
+            # first n LIVE rows survive via the inclusive prefix count
+            (c,) = childs
+            t, alive = ops.sort_table_capped(
+                c.table, key_names=list(node.keys),
+                ascending=list(node.ascending), alive=c.alive)
+            prefix = jnp.cumsum(alive.astype(jnp.int32))
+            return _CappedRel(t, alive & (prefix <= node.n)), None
         if isinstance(node, Limit):
             (c,) = childs
             # first n LIVE rows: inclusive prefix count over the mask

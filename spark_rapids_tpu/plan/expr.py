@@ -198,6 +198,84 @@ def _reduce_identity(op: str, dtype):
     return jnp.asarray(info.min if op == "max" else info.max, dtype)
 
 
+# ---- structural helpers (the optimizer's expression toolkit) ----------------
+
+def _foldable(v) -> bool:
+    """Folded python arithmetic matches runtime jnp arithmetic because the
+    engine runs under x64 (int64/float64 storage, enabled at import): an
+    int that no longer fits int64 would RAISE at Literal.evaluate where
+    the unfolded tree silently wraps — don't fold those."""
+    if isinstance(v, bool) or not isinstance(v, int):
+        return True
+    return -(2 ** 63) <= v < 2 ** 63
+
+
+def fold(e: Expr) -> Expr:
+    """Constant-fold literal-only subtrees bottom-up. `BinOp(lit, lit)` and
+    `UnaryOp(lit)` become a `Literal` of the evaluated python value —
+    including comparisons, so a whole literal predicate reduces to
+    `Literal(True/False)` and the optimizer's trivial-predicate rule can
+    drop/short-circuit the Filter. Returns `e` itself when nothing folded
+    (callers detect a rewrite by identity). Scalar aggregates never fold:
+    even over a literal, their value depends on the live-row set (an
+    empty relation reduces max/min to the identity, sum to n*v)."""
+    if isinstance(e, BinOp):
+        l, r = fold(e.left), fold(e.right)
+        if isinstance(l, Literal) and isinstance(r, Literal):
+            v = _BIN_FNS[e.op](l.value, r.value)
+            if _foldable(v):
+                return Literal(v)
+        if l is e.left and r is e.right:
+            return e
+        return BinOp(e.op, l, r)
+    if isinstance(e, UnaryOp):
+        c = fold(e.child)
+        if isinstance(c, Literal):
+            if e.op == "~":
+                # python's ~True is -2; the jnp evaluation of ~ on a bool
+                # array is logical not — fold must match the array semantics
+                v = (not c.value) if isinstance(c.value, bool) else ~c.value
+            else:
+                v = -c.value
+            if _foldable(v):
+                return Literal(v)
+        return e if c is e.child else UnaryOp(e.op, c)
+    if isinstance(e, ScalarAgg):
+        c = fold(e.child)
+        return e if c is e.child else ScalarAgg(e.op, c)
+    return e
+
+
+def substitute(e: Expr, mapping) -> Expr:
+    """Replace every `ColumnRef(name)` with `mapping[name]` (an Expr) —
+    how a predicate is rewritten through a Project during pushdown.
+    Unmapped names raise KeyError (callers guard with references())."""
+    if isinstance(e, ColumnRef):
+        return mapping[e.name]
+    if isinstance(e, BinOp):
+        return BinOp(e.op, substitute(e.left, mapping),
+                     substitute(e.right, mapping))
+    if isinstance(e, UnaryOp):
+        return UnaryOp(e.op, substitute(e.child, mapping))
+    if isinstance(e, ScalarAgg):
+        return ScalarAgg(e.op, substitute(e.child, mapping))
+    return e
+
+
+def has_scalar_agg(e: Expr) -> bool:
+    """Whether the expression contains a whole-relation scalar aggregate —
+    such expressions are NOT row-wise, so reorderings that change the row
+    set under them (pushdown below a join/union, limit pushdown) are
+    invalid and the optimizer must skip them."""
+    if isinstance(e, ScalarAgg):
+        return True
+    if isinstance(e, BinOp):
+        return has_scalar_agg(e.left) or has_scalar_agg(e.right)
+    if isinstance(e, UnaryOp):
+        return has_scalar_agg(e.child)
+    return False
+
+
 # ---- public constructors ----------------------------------------------------
 
 def col(name: str) -> ColumnRef:

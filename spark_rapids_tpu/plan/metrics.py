@@ -41,13 +41,25 @@ def render_profile(rows: List[OperatorMetrics],
                    attempts: int = 1,
                    caps: Optional[Dict] = None,
                    degraded: bool = False,
-                   breaker: Optional[Dict] = None) -> str:
+                   breaker: Optional[Dict] = None,
+                   optimizer: Optional[Dict] = None,
+                   jit_cache_hits: int = 0) -> str:
     """Human-readable profile table (the `profile()` text form)."""
     out = []
     if plan_wall_ms is not None:
         caps_s = f" caps={caps}" if caps else ""
+        hits_s = f", {jit_cache_hits} jit cache hit(s)" if jit_cache_hits \
+            else ""
         out.append(f"plan: {plan_wall_ms:.3f} ms, "
-                   f"{attempts} attempt(s){caps_s}")
+                   f"{attempts} attempt(s){caps_s}{hits_s}")
+    if optimizer is not None:
+        fired = optimizer.get("rules_fired") or {}
+        pruned = optimizer.get("pruned_columns", 0)
+        out.append(f"optimizer: rules_fired={fired or 'none'}"
+                   + (f", pruned {pruned} column(s) "
+                      f"(~{optimizer.get('pruned_bytes_est', 0)} B est)"
+                      if pruned else "")
+                   + f", fingerprint={optimizer.get('fingerprint', '')}")
     if degraded:
         reason = (breaker or {}).get("reason")
         state = (breaker or {}).get("state", "open")

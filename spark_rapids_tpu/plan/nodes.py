@@ -66,22 +66,42 @@ class PlanNode:
 class Scan(PlanNode):
     """Leaf: one named input relation, bound to a concrete Table at
     execute() (`inputs={name: table}`). A declared `schema` validates at
-    build time and is checked against the bound table."""
+    build time and is checked against the bound table. `projection`
+    (set by the optimizer's column-pruning rule) narrows the output to a
+    subset of the bound columns — unpruned columns never enter the plan.
+    `est_rows` is an optional cardinality hint for the optimizer's
+    build-side selection when no table is bound yet."""
     source: str
     schema: Optional[Tuple[str, ...]] = None
+    projection: Optional[Tuple[str, ...]] = None
+    est_rows: Optional[int] = None
 
     def __post_init__(self):
         super().__post_init__()
         if self.schema is not None:
             object.__setattr__(self, "schema", tuple(self.schema))
+        if self.projection is not None:
+            object.__setattr__(self, "projection", tuple(self.projection))
 
     def output_names(self, child_schemas):
         _require(self.schema is not None,
                  f"{self.label}: schema for input {self.source!r} is unknown "
                  "(declare it at scan() or bind inputs)")
-        return self.schema
+        return self.apply_projection(self.schema)
+
+    def apply_projection(self, schema) -> Tuple[str, ...]:
+        """Narrowed output over a (declared or bound) full schema."""
+        if self.projection is None:
+            return tuple(schema)
+        missing = set(self.projection) - set(schema)
+        _require(not missing,
+                 f"{self.label}: projected column(s) {sorted(missing)} not "
+                 f"in {list(schema)}")
+        return self.projection
 
     def describe(self):
+        if self.projection is not None:
+            return f"{self.source} [{', '.join(self.projection)}]"
         return self.source
 
 
@@ -135,6 +155,47 @@ class Project(PlanNode):
 
     def describe(self):
         return ", ".join(f"{e!r} AS {n}" for n, e in self.exprs)
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class FusedSelect(PlanNode):
+    """Filter + Project in one operator (optimizer-produced: the
+    `select_fusion` rule rewrites Project(Filter(c)) into this). Semantics:
+    rows passing `predicate` (over the CHILD schema), projected to `exprs`.
+    The eager tier gathers only the projection-referenced columns once,
+    instead of materializing the full filtered child and projecting it."""
+    child: PlanNode
+    predicate: Expr
+    exprs: Tuple[Tuple[str, Expr], ...]
+
+    def __post_init__(self):
+        super().__post_init__()
+        object.__setattr__(self, "exprs", tuple(
+            (n, e) for n, e in self.exprs))
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def output_names(self, child_schemas):
+        (schema,) = child_schemas
+        missing = self.predicate.references() - set(schema)
+        _require(not missing,
+                 f"{self.label}: predicate references unknown column(s) "
+                 f"{sorted(missing)} (have {list(schema)})")
+        names = [n for n, _ in self.exprs]
+        _require(len(set(names)) == len(names),
+                 f"{self.label}: duplicate output name in {names}")
+        for n, e in self.exprs:
+            missing = e.references() - set(schema)
+            _require(not missing,
+                     f"{self.label}: {n!r} references unknown column(s) "
+                     f"{sorted(missing)} (have {list(schema)})")
+        return tuple(names)
+
+    def describe(self):
+        proj = ", ".join(f"{e!r} AS {n}" for n, e in self.exprs)
+        return f"{self.predicate!r} -> {proj}"
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
@@ -269,6 +330,43 @@ class Sort(PlanNode):
     def describe(self):
         return ", ".join(f"{k} {'ASC' if a else 'DESC'}"
                          for k, a in zip(self.keys, self.ascending))
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class TopK(PlanNode):
+    """Sort + Limit in one operator (optimizer-produced: the
+    `limit_pushdown` rule rewrites Limit(Sort(c)) into this). Output: the
+    first `n` rows of the sorted relation — one operator, one metrics row,
+    one traversal step in both tiers."""
+    child: PlanNode
+    keys: Tuple[str, ...]
+    ascending: Tuple[bool, ...]
+    n: int
+
+    def __post_init__(self):
+        super().__post_init__()
+        object.__setattr__(self, "keys", tuple(self.keys))
+        object.__setattr__(self, "ascending", tuple(self.ascending))
+        _require(len(self.keys) > 0, f"{self.label}: needs sort keys")
+        _require(len(self.ascending) == len(self.keys),
+                 f"{self.label}: ascending list must match the key count")
+        _require(self.n >= 0, f"{self.label}: negative limit {self.n}")
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def output_names(self, child_schemas):
+        (schema,) = child_schemas
+        missing = set(self.keys) - set(schema)
+        _require(not missing, f"{self.label}: sort key(s) "
+                              f"{sorted(missing)} not in {list(schema)}")
+        return schema
+
+    def describe(self):
+        keys = ", ".join(f"{k} {'ASC' if a else 'DESC'}"
+                         for k, a in zip(self.keys, self.ascending))
+        return f"top {self.n} by {keys}"
 
 
 @dataclasses.dataclass(frozen=True, eq=False)

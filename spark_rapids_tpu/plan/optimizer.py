@@ -1,0 +1,729 @@
+"""Rule-based plan optimizer: Catalyst-style logical rewrites before tier
+dispatch.
+
+The reference plugin receives plans AFTER Spark's Catalyst optimizer has
+rewritten them; this engine's builder hands over plans exactly as authored.
+On accelerators the dominant wins come from not moving or computing
+unneeded columns and rows before any HBM byte is touched ("Accelerating
+Presto with GPUs", "Do GPUs Really Need New Tabular File Formats?" —
+PAPERS.md), so `PlanExecutor.execute()` runs this pipeline by default
+(`SPARK_RAPIDS_TPU_OPTIMIZER=off`, or `PlanExecutor(optimize=False)`, to
+disable) and executes the rewritten DAG on whichever tier was selected.
+
+Rules — each a pure `root -> root'` rewrite, the pipeline run to fixpoint
+with a pass-count guard (`MAX_PASSES`):
+
+- `constant_folding`: literal-only expression subtrees fold to `Literal`s
+  (expr.fold); `Filter(true)` drops; `Filter(false)` short-circuits to
+  `Limit(0)` (an empty relation of the same schema — no new node kind).
+- `predicate_pushdown`: Filter moves below Project (predicate rewritten
+  through cheap ColumnRef/Literal projections), below Union (one copy per
+  input), and into the side of a HashJoin whose columns it references —
+  rows die before the join/union/materialization instead of after.
+- `limit_pushdown`: Limit(Limit) collapses, Limit moves below row-wise
+  Projects, and Limit(Sort) fuses into one `TopK` operator.
+- `build_side`: inner-join children swap (plus a column-order-restoring
+  Project) when row-count estimates say the left side is much smaller —
+  the smaller relation becomes the right/build side, as a CBO picks.
+  Estimates come from bound table sizes, falling back to the `est_rows`
+  scan hint threaded through `PlanBuilder.scan()`. Swapping reorders the
+  join's output ROWS, so the rule fires only where that order is
+  unobservable — every path to the root crosses a HashAggregate — keeping
+  results row-for-row identical.
+- `column_pruning`: required columns walk top-down through the DAG;
+  Scans narrow to a `projection` (unused columns never enter the plan),
+  Project/FusedSelect outputs and HashAggregate agg lists drop dead
+  entries, and width-sensitive operators (join/aggregate/sort/exchange
+  inputs) get a zero-copy select-Project inserted when their input still
+  carries dead columns (e.g. a Filter's predicate-only columns).
+- `select_fusion`: adjacent Filters merge (`a & b`) and Project(Filter)
+  fuses into one `FusedSelect` node, so the eager tier gathers the
+  projection-referenced columns once instead of materializing the full
+  filtered relation first.
+
+DAG sharing is preserved: rewrites memoize per node object, and rules that
+restructure a parent/child pair skip children referenced by more than one
+parent (restructuring would un-share the subtree and re-execute it).
+Scalar-aggregate expressions (`scalar_max(...)`) are never moved across
+operators that change their input row set.
+
+`plan_fingerprint` is the canonical structural hash (node kinds, params,
+exprs, declared schemas, DAG shape) the executor keys its compiled-program
+and caps memos by, so structurally identical plans built independently
+share compiled XLA programs — see `Plan.fingerprint`.
+
+If a rewritten DAG fails re-validation (a defensive impossibility given
+the rule guards, but plans are user input), `optimize` falls back to the
+authored plan and reports `fell_back=True` instead of failing the query.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Dict, List, Optional, Tuple
+
+from .builder import Plan, _toposort
+from .expr import (BinOp, ColumnRef, Expr, Literal, ScalarAgg, UnaryOp,
+                   col, fold, has_scalar_agg, substitute)
+from .nodes import (Exchange, Filter, FusedSelect, HashAggregate, HashJoin,
+                    Limit, PlanNode, PlanValidationError, Project, Scan,
+                    Sort, TopK, Union)
+
+__all__ = ["optimize", "plan_fingerprint", "OptimizeReport", "RULE_NAMES",
+           "MAX_PASSES"]
+
+MAX_PASSES = 10           # fixpoint guard: rewrite passes, not rewrites
+_EST_BYTES_PER_CELL = 8   # the engine's INT64-tier column width
+
+
+# ---- fingerprint ------------------------------------------------------------
+
+# pure hints that do not change the program a plan compiles to
+_FP_SKIP_FIELDS = {"est_rows"}
+
+
+def _fp_expr(e: Expr) -> Tuple:
+    """Type-TAGGED expression serialization: `col("1")` and `lit(1)` repr
+    identically ("1") but must hash apart — a collision would let two
+    semantically different plans share one compiled program."""
+    if isinstance(e, ColumnRef):
+        return ("col", e.name)
+    if isinstance(e, Literal):
+        return ("lit", repr(e.value))
+    if isinstance(e, BinOp):
+        return ("bin", e.op, _fp_expr(e.left), _fp_expr(e.right))
+    if isinstance(e, UnaryOp):
+        return ("un", e.op, _fp_expr(e.child))
+    if isinstance(e, ScalarAgg):
+        return ("agg", e.op, _fp_expr(e.child))
+    return ("expr", repr(e))
+
+
+def _fp_value(v) -> object:
+    if isinstance(v, Expr):
+        return _fp_expr(v)
+    if isinstance(v, tuple):
+        return tuple(_fp_value(x) for x in v)
+    return repr(v)
+
+
+def _node_params(node: PlanNode) -> Tuple:
+    """Canonical value tuple over the node's non-child parameters; exprs
+    serialize type-tagged (`_fp_expr`), so the hash distinguishes a
+    mutated literal — and a literal from a same-repr column ref — but not
+    a rebuilt-identical plan."""
+    params = []
+    for f in dataclasses.fields(node):
+        if f.name in _FP_SKIP_FIELDS:
+            continue
+        v = getattr(node, f.name)
+        if isinstance(v, PlanNode):
+            continue
+        if isinstance(v, tuple) and v and isinstance(v[0], PlanNode):
+            continue
+        params.append((f.name, _fp_value(v)))
+    return tuple(params)
+
+
+def plan_fingerprint(plan: Plan) -> str:
+    """Structural hash over the plan DAG: per node (kind, params, child
+    indices in toposort order). The toposort is deterministic for a given
+    structure, so two independently built identical plans — including the
+    same subtree-sharing shape — hash equal."""
+    nodes = plan.nodes
+    index = {id(n): i for i, n in enumerate(nodes)}
+    toks = [(n.kind, _node_params(n),
+             tuple(index[id(c)] for c in n.children)) for n in nodes]
+    return hashlib.sha256(repr(toks).encode()).hexdigest()[:16]
+
+
+# ---- report -----------------------------------------------------------------
+
+RULE_NAMES = ("constant_folding", "predicate_pushdown", "limit_pushdown",
+              "build_side", "column_pruning", "select_fusion")
+
+
+@dataclasses.dataclass
+class OptimizeReport:
+    """What the pipeline did to one plan — surfaced by explain(optimized=
+    True), PlanResult.optimizer, and the bench JSONL `rules_fired` field."""
+    rules: Dict[str, int]
+    passes: int = 0
+    pruned_columns: int = 0        # columns dropped (scan/project/insert)
+    pruned_bytes_est: int = 0      # est rows x 8B per dropped column
+    source_fingerprint: str = ""
+    fingerprint: str = ""
+    fell_back: bool = False
+
+    def rules_fired(self) -> Dict[str, int]:
+        return {k: v for k, v in self.rules.items() if v}
+
+    def total_rewrites(self) -> int:
+        return sum(self.rules.values())
+
+    def to_dict(self) -> Dict:
+        return {"rules_fired": self.rules_fired(), "passes": self.passes,
+                "pruned_columns": self.pruned_columns,
+                "pruned_bytes_est": self.pruned_bytes_est,
+                "fingerprint": self.fingerprint,
+                "source_fingerprint": self.source_fingerprint,
+                "fell_back": self.fell_back}
+
+    def summary(self) -> str:
+        lines = [f"optimizer: {self.passes} pass(es), "
+                 f"{self.total_rewrites()} rewrite(s)"
+                 + (" [FELL BACK: re-validation failed, authored plan ran]"
+                    if self.fell_back else "")]
+        for name, n in self.rules_fired().items():
+            lines.append(f"  {name}: {n}")
+        if self.pruned_columns:
+            lines.append(f"  pruned {self.pruned_columns} column(s) "
+                         f"(~{self.pruned_bytes_est} bytes est)")
+        lines.append(f"  fingerprint {self.source_fingerprint} -> "
+                     f"{self.fingerprint}")
+        return "\n".join(lines)
+
+
+# ---- rewrite infrastructure -------------------------------------------------
+
+def _with_children(node: PlanNode, kids: Tuple[PlanNode, ...]) -> PlanNode:
+    if isinstance(node, HashJoin):
+        return dataclasses.replace(node, left=kids[0], right=kids[1])
+    if isinstance(node, Union):
+        return dataclasses.replace(node, inputs=tuple(kids))
+    if node.children:
+        return dataclasses.replace(node, child=kids[0])
+    return node
+
+
+def _rewrite(root: PlanNode, fn, shared: Optional[set] = None) -> PlanNode:
+    """Bottom-up memoized rewrite. `fn(node) -> replacement | None` runs on
+    each node AFTER its children were rewritten; the memo keys on the
+    original objects so DAG-shared subtrees rewrite once and stay shared.
+
+    `shared` (the pass's shared-node id set) is kept LIVE: when a shared
+    original is rebuilt with rewritten children, the rebuilt node's id
+    joins the set — a parent-side guard checking `id(child) in shared`
+    would otherwise pass on the fresh object and un-share the subtree."""
+    memo: Dict[int, PlanNode] = {}
+
+    def go(node: PlanNode) -> PlanNode:
+        got = memo.get(id(node))
+        if got is not None:
+            return got
+        kids = tuple(go(c) for c in node.children)
+        if any(k is not c for k, c in zip(kids, node.children)):
+            node2 = _with_children(node, kids)
+        else:
+            node2 = node
+        if shared is not None and node2 is not node and id(node) in shared:
+            shared.add(id(node2))
+        out = fn(node2)
+        memo[id(node)] = node2 if out is None else out
+        return memo[id(node)]
+
+    return go(root)
+
+
+def _shared_ids(root: PlanNode) -> set:
+    """ids of nodes referenced by >1 parent — rules that restructure a
+    parent/child pair must skip these or the subtree would un-share."""
+    counts: Dict[int, int] = {}
+    for n in _toposort(root):
+        for c in n.children:
+            counts[id(c)] = counts.get(id(c), 0) + 1
+    return {i for i, c in counts.items() if c > 1}
+
+
+class _Schemas:
+    """Lazy output-schema resolver usable on any node, old or freshly
+    rewritten. Unresolvable subtrees (scan without declared schema and no
+    binding) resolve to None and schema-dependent rules skip them."""
+
+    def __init__(self, bound: Optional[Dict[str, Tuple[str, ...]]] = None):
+        self.bound = dict(bound or {})
+        self.memo: Dict[int, Optional[Tuple[str, ...]]] = {}
+
+    def of(self, node: PlanNode) -> Optional[Tuple[str, ...]]:
+        got = self.memo.get(id(node), _Schemas)
+        if got is not _Schemas:
+            return got
+        if isinstance(node, Scan):
+            base = self.bound.get(node.source, node.schema)
+            s = None if base is None else node.apply_projection(base)
+        else:
+            kids = [self.of(c) for c in node.children]
+            s = (None if any(k is None for k in kids)
+                 else tuple(node.output_names(kids)))
+        self.memo[id(node)] = s
+        return s
+
+
+class _Estimator:
+    """Row-count estimates, bottom-up. Bound table sizes win; `est_rows`
+    scan hints fill in; None propagates (rules skip). Selectivity guesses
+    are crude on purpose — only the build_side rule consumes them, and it
+    swaps only on a 2x margin."""
+
+    def __init__(self, bound_rows: Optional[Dict[str, int]] = None):
+        self.bound = dict(bound_rows or {})
+        self.memo: Dict[int, Optional[float]] = {}
+
+    def of(self, node: PlanNode) -> Optional[float]:
+        got = self.memo.get(id(node), _Estimator)
+        if got is not _Estimator:
+            return got
+        e = self._compute(node)
+        self.memo[id(node)] = e
+        return e
+
+    def _compute(self, node: PlanNode) -> Optional[float]:
+        if isinstance(node, Scan):
+            v = self.bound.get(node.source, node.est_rows)
+            return None if v is None else float(v)
+        kids = [self.of(c) for c in node.children]
+        if any(k is None for k in kids):
+            return None
+        if isinstance(node, (Filter, FusedSelect)):
+            return 0.5 * kids[0]
+        if isinstance(node, (Project, Exchange, Sort)):
+            return kids[0]
+        if isinstance(node, Limit):
+            return min(float(node.n), kids[0])
+        if isinstance(node, TopK):
+            return min(float(node.n), kids[0])
+        if isinstance(node, Union):
+            return sum(kids)
+        if isinstance(node, HashJoin):
+            if node.how == "inner":
+                return max(kids)
+            return 0.5 * kids[0]
+        if isinstance(node, HashAggregate):
+            if not node.keys:
+                return 1.0
+            return max(1.0, kids[0] / 10.0)    # distinct-key guess
+        return kids[0] if kids else None
+
+
+# ---- rules ------------------------------------------------------------------
+# Each rule: (root, ctx) -> (root', hits). ctx carries schemas/estimates/
+# shared-ids computed fresh for the pass, plus the report for prune stats.
+
+class _Ctx:
+    def __init__(self, root, bound, bound_rows, report,
+                 float_inputs=False):
+        self.schemas = _Schemas(bound)
+        self.est = _Estimator(bound_rows)
+        self.shared = _shared_ids(root)
+        self.report = report
+        self.float_inputs = float_inputs
+
+
+def _rule_constant_folding(root, ctx):
+    hits = [0]
+
+    def fn(node):
+        if isinstance(node, Filter):
+            p = fold(node.predicate)
+            if isinstance(p, Literal):
+                hits[0] += 1
+                if bool(p.value):
+                    return node.child              # Filter(true): drop
+                return Limit(node.child, 0)        # Filter(false): empty
+            if p is not node.predicate:
+                hits[0] += 1
+                return dataclasses.replace(node, predicate=p)
+            return None
+        if isinstance(node, FusedSelect):
+            p = fold(node.predicate)
+            exprs = tuple((n, fold(e)) for n, e in node.exprs)
+            changed = (p is not node.predicate or
+                       any(e is not o for (_, e), (_, o)
+                           in zip(exprs, node.exprs)))
+            if isinstance(p, Literal):
+                hits[0] += 1
+                child = (node.child if bool(p.value)
+                         else Limit(node.child, 0))
+                return Project(child, exprs)
+            if changed:
+                hits[0] += 1
+                return FusedSelect(node.child, p, exprs)
+            return None
+        if isinstance(node, Project):
+            exprs = tuple((n, fold(e)) for n, e in node.exprs)
+            if any(e is not o for (_, e), (_, o) in zip(exprs, node.exprs)):
+                hits[0] += 1
+                return dataclasses.replace(node, exprs=exprs)
+        return None
+
+    return _rewrite(root, fn), hits[0]
+
+
+def _rule_predicate_pushdown(root, ctx):
+    hits = [0]
+
+    def fn(node):
+        if not isinstance(node, Filter):
+            return None
+        child, p = node.child, node.predicate
+        if id(child) in ctx.shared:
+            return None    # restructuring would un-share the subtree
+        if isinstance(child, Project):
+            if any(has_scalar_agg(e) for _, e in child.exprs):
+                # the filter below would change the row set the project's
+                # scalar aggregate reduces over — same hazard (and guard)
+                # as limit_pushdown's Project branch
+                return None
+            mapping = dict(child.exprs)
+            refs = p.references()
+            # substitute only through cheap projections: re-evaluating a
+            # computed expression twice would trade bytes for FLOPs
+            if refs <= set(mapping) and all(
+                    isinstance(mapping[r], (ColumnRef, Literal))
+                    for r in refs):
+                hits[0] += 1
+                pushed = Filter(child.child, substitute(p, mapping))
+                return dataclasses.replace(child, child=pushed)
+            return None
+        if isinstance(child, Union) and not has_scalar_agg(p):
+            hits[0] += 1
+            return Union(tuple(Filter(i, p) for i in child.inputs))
+        if isinstance(child, HashJoin) and not has_scalar_agg(p):
+            refs = p.references()
+            ls = ctx.schemas.of(child.left)
+            rs = ctx.schemas.of(child.right)
+            if child.how == "inner" and rs is not None and refs <= set(rs):
+                hits[0] += 1
+                return dataclasses.replace(
+                    child, right=Filter(child.right, p))
+            if ls is not None and refs <= set(ls):
+                # inner: left-only columns; semi/anti: output IS the left
+                # schema, so a row filter always commutes to the left side
+                hits[0] += 1
+                return dataclasses.replace(child, left=Filter(child.left, p))
+        return None
+
+    return _rewrite(root, fn, ctx.shared), hits[0]
+
+
+def _rule_limit_pushdown(root, ctx):
+    hits = [0]
+
+    def fn(node):
+        if not isinstance(node, Limit):
+            return None
+        c = node.child
+        if id(c) in ctx.shared:
+            return None
+        if isinstance(c, Limit):
+            hits[0] += 1
+            return Limit(c.child, min(node.n, c.n))
+        if isinstance(c, Project) and not any(
+                has_scalar_agg(e) for _, e in c.exprs):
+            hits[0] += 1
+            return dataclasses.replace(c, child=Limit(c.child, node.n))
+        if isinstance(c, Sort):
+            hits[0] += 1
+            return TopK(c.child, c.keys, c.ascending, node.n)
+        return None
+
+    return _rewrite(root, fn, ctx.shared), hits[0]
+
+
+def _order_safe_ids(root: PlanNode) -> set:
+    """ids of nodes whose output ROW ORDER is unobservable: every path to
+    the root passes through a HashAggregate (whose output order depends on
+    keys, not input order) via operators that merely propagate rows.
+    Swapping a join reorders its output rows, so the build_side rule only
+    fires inside these regions — result parity stays row-for-row exact.
+    (Sort is NOT a pass-through: a stable sort exposes input order on key
+    ties; Limit/TopK take the first n rows, observably.)"""
+    nodes = _toposort(root)
+    parents: Dict[int, List[PlanNode]] = {}
+    for n in nodes:
+        for c in n.children:
+            parents.setdefault(id(c), []).append(n)
+    pass_through = (Filter, FusedSelect, Project, HashJoin, Union, Exchange)
+    safe: Dict[int, bool] = {}
+    for n in reversed(nodes):             # parents before children
+        ps = parents.get(id(n), [])
+        safe[id(n)] = bool(ps) and all(
+            isinstance(p, HashAggregate)
+            or (isinstance(p, pass_through) and safe[id(p)])
+            for p in ps)
+    return {i for i, v in safe.items() if v}
+
+
+def _rule_build_side(root, ctx):
+    hits = [0]
+    if ctx.float_inputs:
+        # floating-point sums/means are not associative: the aggregate
+        # above absorbs the ROW reorder but not the fp reduction-order
+        # change on m:n joins (within-group pair enumeration flips), so
+        # bit-exact parity only holds for exact (integer/bool) inputs —
+        # skip the rule entirely when any bound input carries floats
+        return root, 0
+    if any(isinstance(n, HashAggregate)
+           and any(o == "mean" for _, o, _ in n.aggs)
+           for n in _toposort(root)):
+        # mean accumulates in float64 even over integer inputs (and its
+        # output stays float for anything above), so a mean anywhere in
+        # the plan reintroduces the fp reorder-exactness problem
+        return root, 0
+    safe = _order_safe_ids(root)
+    memo: Dict[int, PlanNode] = {}
+
+    def go(n: PlanNode) -> PlanNode:      # custom recursion: the safety
+        got = memo.get(id(n))             # set keys on ORIGINAL node ids
+        if got is not None:
+            return got
+        kids = tuple(go(c) for c in n.children)
+        node2 = (_with_children(n, kids)
+                 if any(k is not c for k, c in zip(kids, n.children)) else n)
+        if (isinstance(n, HashJoin) and n.how == "inner"
+                and id(n) in safe):
+            le = ctx.est.of(n.left)
+            re_ = ctx.est.of(n.right)
+            ls = ctx.schemas.of(n.left)
+            rs = ctx.schemas.of(n.right)
+            # 2x hysteresis: swap only on a clear margin so the rule is
+            # stable (the swapped join's sides never re-qualify)
+            if None not in (le, re_, ls, rs) and le * 2 < re_:
+                hits[0] += 1
+                swapped = HashJoin(node2.right, node2.left, n.right_keys,
+                                   n.left_keys, how="inner",
+                                   row_cap=n.row_cap)
+                order = tuple(ls) + tuple(rs)   # restore authored order
+                node2 = Project(swapped,
+                                tuple((nm, col(nm)) for nm in order))
+        memo[id(n)] = node2
+        return node2
+
+    return go(root), hits[0]
+
+
+def _rule_select_fusion(root, ctx):
+    hits = [0]
+
+    def fn(node):
+        if (isinstance(node, Filter) and isinstance(node.child, Filter)
+                and id(node.child) not in ctx.shared
+                and not has_scalar_agg(node.predicate)):
+            # inner predicate first is irrelevant for a row-wise AND; a
+            # scalar-agg outer predicate reduces over the FILTERED rows,
+            # so it must not move over the inner filter
+            inner = node.child
+            hits[0] += 1
+            return Filter(inner.child, inner.predicate & node.predicate)
+        if (isinstance(node, Project) and isinstance(node.child, Filter)
+                and id(node.child) not in ctx.shared):
+            f = node.child
+            hits[0] += 1
+            return FusedSelect(f.child, f.predicate, node.exprs)
+        return None
+
+    return _rewrite(root, fn, ctx.shared), hits[0]
+
+
+# width-sensitive operators: a dead column crossing one of these edges is
+# materialized/sorted/shuffled, so a zero-copy select pays for itself
+_NARROW_PARENTS = (HashJoin, HashAggregate, Sort, TopK, Exchange)
+
+
+def _rule_column_pruning(root, ctx):
+    nodes = _toposort(root)
+    schemas = {id(n): ctx.schemas.of(n) for n in nodes}
+    if any(s is None for s in schemas.values()):
+        return root, 0                    # unresolved subtree: skip the pass
+    required: Dict[int, set] = {}
+    extra: Dict[int, set] = {}     # union-equalization floor (see below)
+    edge_req: Dict[Tuple[int, int], set] = {}
+
+    def req_of(n):
+        return required[id(n)] | extra.get(id(n), set())
+
+    def push(parent, i, req):
+        edge_req[(id(parent), i)] = req
+        required[id(parent.children[i])] |= req
+
+    # Recompute until stable: Union inputs must all narrow to the SAME
+    # schema (positional contract), but a DAG-shared input can pick up
+    # extra requirements from parents OUTSIDE the union — equalize every
+    # union's inputs to their union-of-requirements and re-propagate.
+    # Requirements only grow, so this terminates well inside the bound.
+    for _ in range(len(nodes) + 1):
+        required = {id(n): set() for n in nodes}
+        edge_req.clear()
+        required[id(root)] = set(schemas[id(root)])
+        # reversed toposort = parents before children: each node's
+        # required set is complete (over all parents) when we reach it
+        for n in reversed(nodes):
+            req = req_of(n)
+            if isinstance(n, Filter):
+                push(n, 0, set(req) | n.predicate.references())
+            elif isinstance(n, (Project, FusedSelect)):
+                kept = [e for name, e in n.exprs if name in req] or \
+                       [n.exprs[0][1]]
+                r = set().union(*[e.references() for e in kept])
+                if isinstance(n, FusedSelect):
+                    r |= n.predicate.references()
+                if not r:                 # all-literal: keep a row carrier
+                    r = {schemas[id(n.children[0])][0]}
+                push(n, 0, r)
+            elif isinstance(n, HashJoin):
+                ls = schemas[id(n.left)]
+                rs = schemas[id(n.right)]
+                if n.how == "inner":
+                    push(n, 0, (req & set(ls)) | set(n.left_keys))
+                    push(n, 1, (req & set(rs)) | set(n.right_keys))
+                else:
+                    push(n, 0, set(req) | set(n.left_keys))
+                    push(n, 1, set(n.right_keys))
+            elif isinstance(n, HashAggregate):
+                kept = [a for a in n.aggs if a[2] in req] or [n.aggs[0]]
+                r = set(n.keys) | {c for c, o, _ in kept if o != "size"}
+                if not r:                 # global size-only aggregate
+                    r = {schemas[id(n.children[0])][0]}
+                push(n, 0, r)
+            elif isinstance(n, (Sort, TopK)):
+                push(n, 0, set(req) | set(n.keys))
+            elif isinstance(n, Exchange):
+                push(n, 0, set(req) | set(n.keys))
+            elif isinstance(n, (Limit, Union)):
+                for i in range(len(n.children)):
+                    push(n, i, set(req))
+        stable = True
+        for n in nodes:
+            if isinstance(n, Union):
+                eq = set().union(*[req_of(c) for c in n.children])
+                for c in n.children:
+                    if req_of(c) != eq:
+                        extra.setdefault(id(c), set()).update(eq)
+                        stable = False
+        if stable:
+            break
+
+    hits = [0]
+    rep = ctx.report
+
+    def note_pruned(n_cols, est_rows):
+        hits[0] += 1
+        rep.pruned_columns += n_cols
+        if est_rows is not None:
+            rep.pruned_bytes_est += int(
+                n_cols * est_rows * _EST_BYTES_PER_CELL)
+
+    memo: Dict[int, PlanNode] = {}
+
+    def go(n: PlanNode) -> PlanNode:
+        got = memo.get(id(n))
+        if got is not None:
+            return got
+        kids = [go(c) for c in n.children]
+        if isinstance(n, _NARROW_PARENTS):
+            for i, (orig_c, new_c) in enumerate(zip(n.children, kids)):
+                if isinstance(new_c, Exchange):
+                    continue    # narrow below it: Exchange is pass-through,
+                    # and a Project in between would break the distributed
+                    # HashAggregate-on-Exchange lowering
+                r = edge_req[(id(n), i)]
+                cs = ctx.schemas.of(new_c)
+                if cs is None or not (set(cs) - r):
+                    continue
+                keep = tuple(c for c in cs if c in r)
+                note_pruned(len(cs) - len(keep), ctx.est.of(orig_c))
+                kids[i] = Project(new_c,
+                                  tuple((c, ColumnRef(c)) for c in keep))
+        node2 = (_with_children(n, tuple(kids))
+                 if any(k is not c for k, c in zip(kids, n.children)) else n)
+        req = req_of(n)
+        if isinstance(n, Scan):
+            cur = schemas[id(n)]
+            keep = tuple(c for c in cur if c in req) or (cur[0],)
+            if keep != tuple(cur):
+                note_pruned(len(cur) - len(keep), ctx.est.of(n))
+                node2 = dataclasses.replace(node2, projection=keep)
+        elif isinstance(n, (Project, FusedSelect)):
+            kept = tuple((name, e) for name, e in n.exprs if name in req) \
+                or (n.exprs[0],)
+            if len(kept) < len(n.exprs):
+                note_pruned(len(n.exprs) - len(kept), ctx.est.of(n))
+                node2 = dataclasses.replace(node2, exprs=kept)
+        elif isinstance(n, HashAggregate):
+            kept = tuple(a for a in n.aggs if a[2] in req) or (n.aggs[0],)
+            if len(kept) < len(n.aggs):
+                note_pruned(len(n.aggs) - len(kept), ctx.est.of(n))
+                node2 = dataclasses.replace(node2, aggs=kept)
+        memo[id(n)] = node2
+        return node2
+
+    return go(root), hits[0]
+
+
+_RULES = (
+    ("constant_folding", _rule_constant_folding),
+    ("predicate_pushdown", _rule_predicate_pushdown),
+    ("limit_pushdown", _rule_limit_pushdown),
+    ("build_side", _rule_build_side),
+    ("column_pruning", _rule_column_pruning),
+    ("select_fusion", _rule_select_fusion),
+)
+
+
+# ---- pipeline ---------------------------------------------------------------
+
+def optimize(plan: Plan,
+             bound: Optional[Dict[str, Tuple[str, ...]]] = None,
+             bound_rows: Optional[Dict[str, int]] = None,
+             max_passes: int = MAX_PASSES,
+             float_inputs: bool = False) -> Tuple[Plan, OptimizeReport]:
+    """Run the rule pipeline to fixpoint over `plan`. `bound` maps scan
+    source -> actual column names and `bound_rows` -> actual row counts
+    (execute() passes both; explain-time callers may pass neither and the
+    schema/estimate-dependent rules degrade gracefully). `float_inputs`
+    disables the build_side rule (execute() sets it when any bound column
+    is floating point — fp reductions are not reorder-exact). Returns the
+    optimized Plan (the SAME object when nothing fired) + the report."""
+    report = OptimizeReport(rules={name: 0 for name, _ in _RULES})
+    report.source_fingerprint = plan.fingerprint
+    root = plan.root
+    for p in range(max_passes):
+        pass_hits = 0
+        for name, rule in _RULES:
+            ctx = _Ctx(root, bound, bound_rows, report, float_inputs)
+            root, n = rule(root, ctx)
+            report.rules[name] += n
+            pass_hits += n
+        report.passes = p + 1
+        if not pass_hits:
+            break
+    if root is plan.root:
+        report.fingerprint = report.source_fingerprint
+        return plan, report
+    try:
+        opt = Plan(root)
+    except PlanValidationError:
+        # defensive: a rewrite produced an invalid DAG — run the authored
+        # plan rather than failing the query. The report must describe
+        # what RAN, so the discarded rewrite's counts are zeroed: a
+        # parity gate reading rules_fired/pruned_columns would otherwise
+        # celebrate rewrites that never executed
+        report.fell_back = True
+        report.rules = {name: 0 for name, _ in _RULES}
+        report.pruned_columns = 0
+        report.pruned_bytes_est = 0
+        report.fingerprint = report.source_fingerprint
+        return plan, report
+    report.fingerprint = opt.fingerprint
+    return opt, report
+
+
+def explain_optimized(plan: Plan,
+                      bound: Optional[Dict[str, Tuple[str, ...]]] = None,
+                      bound_rows: Optional[Dict[str, int]] = None) -> str:
+    """Authored tree, optimized tree, and the per-rule rewrite summary —
+    the `explain(plan, optimized=True)` rendering."""
+    opt, report = optimize(plan, bound, bound_rows)
+    return "\n".join(["== authored ==", plan.explain(), "",
+                      "== optimized ==", opt.explain(), "",
+                      report.summary()])

@@ -1,0 +1,35 @@
+"""Bounded LRU dict shared by the engine's program/memo caches.
+
+One definition for every cache that must not pin dead plans forever: the
+plan executor's compiled-program and caps memos and the optimizer's
+rewrite/fingerprint caches all hold per-plan artifacts while executors
+live for a whole job and front-ends may hand them a fresh Plan per query.
+
+Semantics (deliberately narrow — the callers use exactly this surface):
+- `get(key)` refreshes recency (the hit becomes most-recently-used);
+- `d[key] = value` inserts as most-recent (overwriting refreshes) and
+  evicts the least-recently-used entries beyond `maxsize`;
+- plain `d[key]` reads do NOT refresh (dict semantics, cheap probes).
+"""
+from __future__ import annotations
+
+
+class LruDict(dict):
+    """Bounded cache: `get` refreshes recency, inserts evict the oldest."""
+
+    def __init__(self, maxsize: int):
+        super().__init__()
+        self.maxsize = maxsize
+
+    def get(self, key, default=None):
+        if key in self:
+            val = super().pop(key)
+            super().__setitem__(key, val)   # re-insert = most recent
+            return val
+        return default
+
+    def __setitem__(self, key, value):
+        super().pop(key, None)
+        super().__setitem__(key, value)
+        while len(self) > self.maxsize:
+            del self[next(iter(self))]

@@ -464,6 +464,50 @@ def test_degraded_tier_survives_active_session(tmp_path, _clean_faultinj):
     assert res.table.to_pydict() == ref.table.to_pydict()
 
 
+def test_optimized_plan_degrades_with_fused_dag(tmp_path, _clean_faultinj):
+    """Optimizer interaction (docs/optimizer.md): an optimized plan that
+    trips the breaker mid-run must salvage and finish on the CPU tier with
+    the OPTIMIZED DAG — fused nodes are not re-expanded, the degraded tier
+    lowers FusedSelect like any other operator."""
+    sales, dims = _tables(n=800)
+    b = PlanBuilder()
+    s = b.scan("sales", schema=["k", "v"])
+    d = b.scan("dims", schema=["dk", "grp"])
+    # the predicate spans BOTH join sides, so pushdown cannot move it and
+    # select_fusion merges Filter+Project into one FusedSelect
+    plan = (s.join(d, left_on="k", right_on="dk")
+             .filter((col("grp") == 1) & (col("v") > 0))
+             .project({"grp": col("grp"), "rev": col("v") * lit(2)})
+             .aggregate(["grp"], [("rev", "sum", "total")])
+             .sort(["grp"])
+             .build())
+    ref = PlanExecutor().execute(plan, {"sales": sales, "dims": dims})
+    fused_kinds = [m.kind for m in ref.metrics.values()]
+    assert "FusedSelect" in fused_kinds          # the rewrite really fired
+
+    # fatal at the Sort: everything upstream (incl. the fused select on the
+    # join's build side) already executed and must salvage as-is
+    faultinj.install(_write_cfg(tmp_path, {"computeFaults": {
+        "plan.Sort": {"percent": 100, "injectionType": 0,
+                      "interceptionCount": 1}}}))
+    res = PlanExecutor().execute(plan, {"sales": sales, "dims": dims})
+    assert res.degraded and res.breaker["reason"] == "fatal"
+    assert res.table.to_pydict() == ref.table.to_pydict()
+    by_kind = {m.kind: m for m in res.metrics.values()}
+    assert "FusedSelect" in by_kind              # optimized DAG, both tiers
+    assert not by_kind["FusedSelect"].degraded   # salvaged, not re-run
+    assert by_kind["Sort"].degraded              # re-ran on the CPU tier
+    assert res.optimizer is not None and res.optimizer["rules_fired"]
+    # poisoned device, fresh executor: the FULLY-degraded run also executes
+    # the optimized DAG (FusedSelect lowers on the CPU tier, no expansion)
+    res2 = PlanExecutor().execute(plan, {"sales": sales, "dims": dims})
+    assert res2.degraded
+    fused2 = next(m for m in res2.metrics.values()
+                  if m.kind == "FusedSelect")
+    assert fused2.degraded
+    assert res2.table.to_pydict() == ref.table.to_pydict()
+
+
 def test_capped_degrade_preserves_retry_accounting(tmp_path, _clean_faultinj):
     """Retries/backoff absorbed on the device path before a capped-tier
     trip must survive into the degraded PlanResult."""

@@ -496,9 +496,11 @@ def test_node_level_cap_override_escalates():
     ex = PlanExecutor(mode="capped", max_cap_attempts=10)
     res = ex.execute(plan, {"sales": sales, "dims": dims})
     assert res.attempts > 1
-    join_label = next(n.label for n in plan.nodes
-                      if getattr(n, "row_cap", None) is not None)
-    assert res.caps[f"row_cap:{join_label}"] > 8
+    # per-node caps key on the EXECUTED plan's toposort index (stable
+    # across fingerprint-equal rebuilds, unlike labels)
+    join_idx = next(i for i, n in enumerate(res.plan.nodes)
+                    if getattr(n, "row_cap", None) is not None)
+    assert res.caps[f"row_cap:{join_idx}"] > 8
     assert res.compact().to_pydict() == ref.table.to_pydict()
 
 

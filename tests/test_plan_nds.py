@@ -16,7 +16,10 @@ from benchmarks.nds_plans import (q3_inputs, q3_plan, q5_inputs, q5_plan,
                                   q23_inputs, q23_plan, q72_inputs,
                                   q72_plan)
 
-N = 30_000
+# 15k keeps this file inside the timed tier-1 budget now that every
+# executor run also optimizes (and capped runs trace the larger rewritten
+# DAGs); parity at this N exercises the same shapes and assertions
+N = 15_000
 
 
 def test_nds_q3_plan_parity():
@@ -32,13 +35,16 @@ def test_nds_q3_plan_parity():
     resc = PlanExecutor(mode="capped").execute(plan, inputs)
     assert resc.compact().to_pydict() == ref
 
-    # per-operator metrics are real numbers, in both tiers
+    # per-operator metrics are real numbers, in both tiers. Metrics cover
+    # the EXECUTED plan (res.plan) — the optimizer rewrites the authored
+    # tree (e.g. pruning q3's unused item columns), so node counts differ
     for r in (res, resc):
         prof = {m["label"]: m for m in r.profile()}
-        assert len(prof) == len(plan.nodes)
+        assert len(prof) == len(r.plan.nodes)
         agg = next(m for m in prof.values() if m["kind"] == "HashAggregate")
         assert agg["rows_out"] == len(ref["revenue"])
         assert agg["bytes_out"] > 0
+    assert res.optimizer is not None and res.optimizer["rules_fired"]
     join1 = next(m for m in res.profile() if m["kind"] == "HashJoin")
     assert join1["wall_ms"] is not None and join1["wall_ms"] > 0
 
