@@ -113,8 +113,16 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
                 impl: str = None, retries: int = None,
                 faults_injected: int = None, degraded: bool = None,
                 optimizer: str = None, rules_fired: Dict = None,
+                io_row_groups_pruned: int = None,
+                io_bytes_skipped: int = None,
+                io_overlap_ms: float = None,
                 **extra) -> Dict:
     """Build + print one bench JSONL record.
+
+    Every record carries `backend` (jax.default_backend() at emit time):
+    the bench trajectory has silently compared CPU-fallback runs against
+    device runs before (ROADMAP cross-cutting note) — a headline number
+    without its backend is not comparable to anything.
 
     Optional robustness fields (the chaos-soak stage records these, see
     benchmarks/chaos_soak.py / docs/robustness.md): `retries` (fault
@@ -126,9 +134,16 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
     optimizer-parity stage record these, see docs/optimizer.md):
     `optimizer` ("on"/"off" — which variant this row measured) and
     `rules_fired` (rule -> rewrite count from PlanResult.optimizer), so
-    the JSONL history shows the before/after trajectory per rule."""
+    the JSONL history shows the before/after trajectory per rule.
+
+    Optional streaming-IO fields (benchmarks/streaming_scan.py, see
+    docs/io.md): `io_row_groups_pruned` (groups skipped via footer
+    min/max stats), `io_bytes_skipped` (compressed chunk bytes never
+    decoded), `io_overlap_ms` (host decode that ran concurrently with
+    execution — the prefetch pipeline's measured win)."""
     rec = {"bench": bench, "axes": axes, "ms": round(ms, 3),
-           "rows_per_s": round(n_rows / (ms * 1e-3))}
+           "rows_per_s": round(n_rows / (ms * 1e-3)),
+           "backend": jax.default_backend()}
     if impl is not None:
         rec["impl"] = impl
     if retries is not None:
@@ -141,6 +156,12 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
         rec["optimizer"] = optimizer
     if rules_fired is not None:
         rec["rules_fired"] = rules_fired
+    if io_row_groups_pruned is not None:
+        rec["io_row_groups_pruned"] = io_row_groups_pruned
+    if io_bytes_skipped is not None:
+        rec["io_bytes_skipped"] = io_bytes_skipped
+    if io_overlap_ms is not None:
+        rec["io_overlap_ms"] = round(io_overlap_ms, 3)
     rec.update(extra)
     print(json.dumps(rec), flush=True)
     return rec

@@ -1,0 +1,147 @@
+"""Streaming-scan gate: pruning, parity, and decode/execute overlap.
+
+The nightly stage for the streaming IO subsystem (docs/io.md). One NDS-like
+pipeline (Scan -> Filter -> Project -> HashAggregate) runs twice over the
+same data — bound to a materialized Table and bound to a parquet file via
+`ParquetSource` — and the stage asserts:
+
+1. result parity, eager AND capped tiers (streaming execution is exact);
+2. a selective predicate prunes > 0 row groups via footer min/max stats,
+   with measurably fewer decoded bytes (`io_bytes_skipped` > 0);
+3. with prefetch enabled (SPARK_RAPIDS_TPU_IO_PREFETCH >= 1), host decode
+   overlaps plan execution: `io_overlap_ms` > 0.
+
+Emits one JSONL row per variant with the io_* fields + backend
+(benchmarks/common.emit_record), so the bench trajectory records what
+pruning and pipelining actually bought per revision.
+"""
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np
+
+from benchmarks.common import emit_record, parse_args
+
+N_ROWS = 400_000
+ROW_GROUP = 25_000          # 16 row groups at full scale
+# The predicate keeps all but the last two row groups: >= 1 group always
+# prunes, and — with at least 8 groups enforced below — the kept chunk
+# count always exceeds the prefetch depth + 1, so some decode can only
+# start AFTER the consumer frees a queue slot, i.e. during execution:
+# measured overlap > 0 is structural, not a timing accident.
+KEEP_ROWS = N_ROWS - 2 * ROW_GROUP
+
+
+def build_file(n_rows: int, path: str, seed: int = 0) -> None:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(seed)
+    t = pa.table({
+        # monotone column: row groups carry disjoint [min, max] ranges, so
+        # a range predicate prunes deterministically
+        "seq": pa.array(np.arange(n_rows), pa.int64()),
+        "key": pa.array(rng.integers(0, 64, n_rows), pa.int64()),
+        "val": pa.array(rng.integers(0, 1_000_000, n_rows), pa.int64()),
+        # never projected: its chunks must be skipped, not post-selected
+        "pad": pa.array(rng.integers(0, 2**40, n_rows), pa.int64()),
+    })
+    pq.write_table(t, path, row_group_size=max(1, ROW_GROUP),
+                   compression="NONE")
+
+
+def build_plan(source_kw):
+    from spark_rapids_tpu.plan import PlanBuilder, col
+    b = PlanBuilder()
+    cutoff = KEEP_ROWS
+    scan = b.scan("t", **source_kw)
+    return (scan.filter((col("seq") < cutoff) & (col("key") >= 8))
+                .project([("key", col("key")), ("val", col("val"))])
+                .aggregate(["key"], [("val", "sum", "s"),
+                                     ("val", "count", "c")])
+                .build())
+
+
+def main() -> int:
+    global N_ROWS, KEEP_ROWS
+    args = parse_args()
+    n_rows = max(ROW_GROUP * 8, int(N_ROWS * args.scale))
+    N_ROWS = n_rows
+    KEEP_ROWS = n_rows - 2 * ROW_GROUP
+
+    from spark_rapids_tpu import Column, Table
+    from spark_rapids_tpu.io import ParquetSource
+    from spark_rapids_tpu.plan import PlanExecutor
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "stream.parquet")
+        build_file(n_rows, path)
+        src = ParquetSource(path)
+
+        import pyarrow.parquet as pq
+        pt = pq.read_table(path)
+        table = Table([Column.from_numpy(pt[name].to_numpy())
+                       for name in pt.column_names],
+                      names=list(pt.column_names))
+
+        plan_pq = build_plan({"parquet": src})
+        plan_tab = build_plan({"schema": list(pt.column_names)})
+
+        failures = []
+        results = {}
+        for mode in ("eager", "capped"):
+            t0 = time.perf_counter()
+            res = PlanExecutor(mode=mode).execute(plan_pq)
+            ms = (time.perf_counter() - t0) * 1e3
+            ref = PlanExecutor(mode=mode).execute(plan_tab, {"t": table})
+            got = (res.compact() if res.valid is not None
+                   else res.table).to_pydict()
+            want = (ref.compact() if ref.valid is not None
+                    else ref.table).to_pydict()
+            if got != want:
+                failures.append(f"{mode}: parquet-bound result diverges "
+                                "from table-bound")
+            scan_m = next(m for m in res.metrics.values()
+                          if m.kind == "Scan")
+            results[mode] = (res, scan_m)
+            emit_record("streaming_scan", {"mode": mode, "rows": n_rows},
+                        ms, n_rows, impl=f"plan_{mode}",
+                        io_row_groups_pruned=scan_m.io_row_groups_pruned,
+                        io_bytes_skipped=scan_m.io_bytes_skipped,
+                        io_overlap_ms=scan_m.io_overlap_ms,
+                        io_row_groups_total=scan_m.io_row_groups_total,
+                        io_decode_ms=round(scan_m.io_decode_ms, 3))
+
+        for mode, (res, scan_m) in results.items():
+            if scan_m.io_row_groups_pruned <= 0:
+                failures.append(
+                    f"{mode}: selective predicate pruned 0 of "
+                    f"{scan_m.io_row_groups_total} row groups")
+            if scan_m.io_bytes_skipped <= 0:
+                failures.append(f"{mode}: no decoded bytes were skipped")
+
+        # overlap gate: eager tier only (capped materializes up front),
+        # and only when the prefetch pipeline is enabled
+        from spark_rapids_tpu import config
+        _, eager_scan = results["eager"]
+        if config.io_prefetch() >= 1 and eager_scan.io_overlap_ms <= 0:
+            failures.append("eager: prefetch enabled but decode/execute "
+                            "overlap is 0 ms")
+
+        if failures:
+            for f in failures:
+                print(f"streaming_scan FAIL: {f}", file=sys.stderr)
+            return 1
+        print(f"streaming_scan OK: "
+              f"{eager_scan.io_row_groups_pruned}/"
+              f"{eager_scan.io_row_groups_total} row groups pruned, "
+              f"{eager_scan.io_bytes_skipped} B skipped, "
+              f"overlap {eager_scan.io_overlap_ms:.3f} ms")
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

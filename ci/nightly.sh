@@ -28,6 +28,11 @@ JAX_PLATFORMS=cpu python benchmarks/chaos_soak.py --scale 0.2 --cpu
 # counts on q5/q72, and a fingerprint-keyed jit-cache hit on a rebuilt
 # plan; emits optimizer/rules_fired JSONL fields
 JAX_PLATFORMS=cpu python benchmarks/optimizer_parity.py --scale 0.1 --cpu
+# streaming-scan gate (docs/io.md): parquet-bound vs table-bound parity in
+# both tiers, nonzero row groups pruned on a selective predicate (with
+# measurably fewer decoded bytes), and decode/execute overlap > 0 with the
+# prefetch pipeline enabled; emits io_* + backend JSONL fields
+JAX_PLATFORMS=cpu python benchmarks/streaming_scan.py --scale 0.5 --cpu
 ./ci/fuzz-test.sh
 ./ci/sanitizer.sh
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

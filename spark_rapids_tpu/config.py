@@ -22,6 +22,8 @@ equivalents, all read at use time (not import time) so tests can monkeypatch:
 | SPARK_RAPIDS_TPU_BREAKER_COOLDOWN_S | 30 | open→half_open self-arm delay (0 = only reset_device) |
 | SPARK_RAPIDS_TPU_BREAKER_DEGRADE | cpu  | cpu (finish tripped plans on the CPU tier) / off |
 | SPARK_RAPIDS_TPU_OPTIMIZER       | on   | rule-based plan optimizer (plan/optimizer.py): on/off |
+| SPARK_RAPIDS_TPU_IO_PREFETCH     | 2    | streaming-scan prefetch depth (chunks decoded ahead); 0 = decode inline |
+| SPARK_RAPIDS_TPU_IO_CHUNK_ROWS   | 0    | streaming-scan morsel row bound (0 = one chunk per row group) |
 
 The SPARK_RAPIDS_TPU_BREAKER_* numeric knobs are snapshotted when a
 `DeviceHealthMonitor` is constructed (one policy per monitor lifetime —
@@ -132,6 +134,24 @@ def optimizer_enabled() -> bool:
         raise ValueError(
             f"SPARK_RAPIDS_TPU_OPTIMIZER={v!r}: expected on or off")
     return v == "on"
+
+
+def io_prefetch() -> int:
+    """Streaming-scan prefetch depth (docs/io.md): how many decoded chunks
+    a source-bound Scan's host decode thread may run ahead of execution —
+    the double-buffer that overlaps host bitstream decode of chunk N+1
+    with device execution of chunk N. 0 disables the thread entirely
+    (decode happens inline on the executing thread)."""
+    return max(0, _int_env("SPARK_RAPIDS_TPU_IO_PREFETCH", 2))
+
+
+def io_chunk_rows() -> int:
+    """Streaming-scan morsel row bound: decoded row groups larger than
+    this split into <= this many rows per chunk, bounding the per-morsel
+    working set independently of how the file was written. 0 (default)
+    streams one chunk per row group. Returns 0 for "unbounded-by-rows";
+    callers treat it as falsy."""
+    return max(0, _int_env("SPARK_RAPIDS_TPU_IO_CHUNK_ROWS", 0))
 
 
 def groupby_kernel() -> str:

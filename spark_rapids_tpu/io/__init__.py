@@ -1,6 +1,8 @@
 from .parquet_footer import (ParquetFooter, StructElement, ListElement,
-                             MapElement, ValueElement)
-from .parquet import ParquetChunkedReader, read_parquet
+                             MapElement, ValueElement, ColumnChunkStats,
+                             RowGroupStats, read_footer_stats)
+from .parquet import (ParquetChunkedReader, ParquetSource, read_parquet,
+                      select_row_groups)
 
 # IO admission: a parquet read has no resident input buffers, so the
 # working-set estimate comes from the source size (encoded bytes × a
@@ -22,4 +24,6 @@ def _parquet_read_estimate(source, *args, **kwargs) -> int:
 read_parquet = _admitted_op(read_parquet, estimator=_parquet_read_estimate)
 
 __all__ = ["ParquetFooter", "StructElement", "ListElement", "MapElement",
-           "ValueElement", "ParquetChunkedReader", "read_parquet"]
+           "ValueElement", "ParquetChunkedReader", "ParquetSource",
+           "read_parquet", "read_footer_stats", "select_row_groups",
+           "ColumnChunkStats", "RowGroupStats"]

@@ -25,7 +25,7 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
-from typing import List, Optional, Sequence, Union
+from typing import List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -175,10 +175,17 @@ class _Leaf:
 
 class ParquetChunkedReader:
     """Reads a parquet file one row group at a time (cudf chunked-reader
-    contract: bounded memory regardless of file size)."""
+    contract: bounded memory regardless of file size).
+
+    `columns=` is SELECTIVE decode: non-requested leaves are dropped from
+    the schema walk before any page is touched, so their column chunks are
+    never decompressed or assembled (not a post-select). `row_groups=`
+    restricts the chunk sequence to the given group indices — the hook
+    min/max footer pruning (parquet_footer.read_footer_stats) drives."""
 
     def __init__(self, source: Union[str, bytes],
-                 columns: Optional[Sequence[str]] = None):
+                 columns: Optional[Sequence[str]] = None,
+                 row_groups: Optional[Sequence[int]] = None):
         self._lib = _native()
         # zero-copy open: mmap files (pages fault in lazily, so decode
         # memory stays bounded per row group) / borrow bytes buffers; the
@@ -216,7 +223,17 @@ class ParquetChunkedReader:
             self._leaves.sort(key=lambda l: order[l.display])
         self.num_row_groups = self._lib.pqr_num_row_groups(self._h)
         self.num_rows = self._lib.pqr_num_rows(self._h)
-        self._next_group = 0
+        if row_groups is None:
+            self._groups = list(range(self.num_row_groups))
+        else:
+            bad = [g for g in row_groups
+                   if not 0 <= int(g) < self.num_row_groups]
+            if bad:
+                raise IndexError(
+                    f"row group(s) {bad} out of range "
+                    f"(file has {self.num_row_groups})")
+            self._groups = [int(g) for g in row_groups]
+        self._next_group = 0        # position in self._groups
 
     def _read_schema(self) -> List[_Leaf]:
         n = self._lib.pqr_num_leaves(self._h)
@@ -306,13 +323,13 @@ class ParquetChunkedReader:
         return names
 
     def has_next(self) -> bool:
-        return self._next_group < self.num_row_groups
+        return self._next_group < len(self._groups)
 
     def read_chunk(self) -> Table:
-        """Decode the next row group into a Table."""
+        """Decode the next (selected) row group into a Table."""
         if not self.has_next():
             raise StopIteration("no more row groups")
-        rg = self._next_group
+        rg = self._groups[self._next_group]
         self._next_group += 1
         return self._read_group(rg)
 
@@ -792,9 +809,173 @@ def _concat_tables(tables: List[Table]) -> Table:
 
 
 def read_parquet(source: Union[str, bytes],
-                 columns: Optional[Sequence[str]] = None) -> Table:
-    """Read a whole parquet file into a Table (filter columns via
-    `columns`; row-group pruning composes via ParquetFooter.read_and_filter
-    + serialize_thrift_file upstream, exactly like the reference flow)."""
-    with ParquetChunkedReader(source, columns=columns) as r:
+                 columns: Optional[Sequence[str]] = None,
+                 row_groups: Optional[Sequence[int]] = None) -> Table:
+    """Read a whole parquet file into a Table (selective decode via
+    `columns`, row-group selection via `row_groups` — stats-driven pruning
+    composes through parquet_footer.read_footer_stats + select_row_groups;
+    the reference flow's ParquetFooter.read_and_filter splice also still
+    works upstream)."""
+    with ParquetChunkedReader(source, columns=columns,
+                              row_groups=row_groups) as r:
         return r.read_all()
+
+
+# ---- stats-driven row-group pruning -----------------------------------------
+
+def _proves_empty(st, op: str, val) -> bool:
+    """True iff `col <op> val` matches NO row of a chunk with stats `st` —
+    provable, never guessed: any missing/undecodable stat, any null in the
+    chunk (null rows carry fill values the row-wise Filter above still
+    sees), or any type mismatch returns False (keep the group)."""
+    if st is None or st.min is None or st.max is None:
+        return False
+    if st.null_count != 0:          # None (unknown) or > 0: cannot prove
+        return False
+    if isinstance(val, str):
+        val = val.encode()          # UTF8 stats order == byte order
+    if isinstance(val, (bytes, bytearray)) != isinstance(st.min, bytes):
+        return False
+    try:
+        if op == "<":
+            return not st.min < val
+        if op == "<=":
+            return not st.min <= val
+        if op == ">":
+            return not st.max > val
+        if op == ">=":
+            return not st.max >= val
+        if op == "==":
+            return val < st.min or val > st.max
+    except TypeError:
+        return False
+    return False
+
+
+def select_row_groups(stats, conjuncts,
+                      num_row_groups: int) -> Tuple[List[int], int]:
+    """(kept row-group indices, pruned count) under min/max pruning.
+
+    `conjuncts` is a list of (column, op, literal) triples that are ANDed
+    above the scan (plan/optimizer.pruning_conjuncts extracts them); a
+    group is dropped only when some conjunct PROVES it holds no matching
+    row, so pruning is parity-exact with the retained Filter. `stats` of
+    None (unparseable footer) keeps everything."""
+    if stats is None or not conjuncts:
+        return list(range(num_row_groups)), 0
+    kept = []
+    for rg in stats:
+        if any(_proves_empty(rg.columns.get(name), op, val)
+               for name, op, val in conjuncts):
+            continue
+        kept.append(rg.index)
+    return kept, num_row_groups - len(kept)
+
+
+class ParquetSource:
+    """A parquet file/bytes source a plan `Scan` binds to INSTEAD of a
+    materialized Table (`PlanBuilder.scan(..., parquet=...)`, or passed as
+    an `inputs=` value at execute()). Schema is read from the footer at
+    construction, so plans over sources validate at build time; data stays
+    on disk until the executor streams it — the streamable prefix of a
+    plan runs morsel-at-a-time (docs/io.md), so bigger-than-budget tables
+    feed the spill/admission machinery instead of materializing up front.
+    """
+
+    is_streaming_source = True
+
+    def __init__(self, source: Union[str, bytes],
+                 chunk_rows: Optional[int] = None):
+        self.source = source
+        self.chunk_rows = chunk_rows      # per-source override of
+        #                                   SPARK_RAPIDS_TPU_IO_CHUNK_ROWS
+        with ParquetChunkedReader(source) as r:
+            self.names = tuple(r.column_names)
+            self.num_rows = int(r.num_rows)
+            self.num_row_groups = int(r.num_row_groups)
+            dts = {}
+            for leaf in r._leaves:
+                if leaf.display not in dts:
+                    try:
+                        dts[leaf.display] = leaf.dtype()
+                    except TypeError:
+                        dts[leaf.display] = None
+            self._dtypes = dts
+        self._stats = False               # lazy; None = unparseable footer
+
+    def __repr__(self):
+        name = self.source if isinstance(self.source, str) else "<bytes>"
+        return (f"ParquetSource({name!r}, rows={self.num_rows}, "
+                f"row_groups={self.num_row_groups})")
+
+    @property
+    def has_floats(self) -> bool:
+        """Any floating column — gates reductions whose result depends on
+        accumulation order (streaming partial aggregation, build_side)."""
+        return any(dt is not None and dt.is_floating
+                   for dt in self._dtypes.values())
+
+    @property
+    def stats(self):
+        """Per-row-group footer statistics, read once; None when the footer
+        stats cannot be parsed (pruning then keeps every group)."""
+        if self._stats is False:
+            from .parquet_footer import read_footer_stats
+            try:
+                self._stats = read_footer_stats(self.source)
+            except Exception:
+                self._stats = None
+        return self._stats
+
+    def select_groups(self, conjuncts=(),
+                      columns: Optional[Sequence[str]] = None):
+        """(kept group indices, pruned count, bytes skipped). Bytes skipped
+        counts compressed column-chunk bytes never decoded: pruned groups
+        entirely, plus non-projected columns of kept groups."""
+        stats = self.stats
+        kept, pruned = select_row_groups(stats, list(conjuncts or ()),
+                                         self.num_row_groups)
+        skipped = 0
+        if stats is not None:
+            sel = None if columns is None else set(columns)
+            kept_set = set(kept)
+            for rg in stats:
+                for st in rg.columns.values():
+                    if rg.index in kept_set and (sel is None
+                                                 or st.column in sel):
+                        continue
+                    skipped += st.total_compressed_size
+        return kept, pruned, skipped
+
+    def chunks(self, columns: Optional[Sequence[str]] = None,
+               row_groups: Optional[Sequence[int]] = None,
+               chunk_rows: Optional[int] = None):
+        """Generator of morsel Tables: one decoded row group per chunk,
+        split into <= chunk_rows slices when a bound is given. An empty
+        selection yields the typed empty table once, so downstream
+        operators always see the scan's schema."""
+        from ..ops.copying import slice_table
+        with ParquetChunkedReader(self.source, columns=columns,
+                                  row_groups=row_groups) as r:
+            if not r.has_next():
+                yield r.read_all()        # typed empty (_empty_columns)
+                return
+            while r.has_next():
+                t = r.read_chunk()
+                if chunk_rows and t.num_rows > chunk_rows:
+                    for off in range(0, t.num_rows, chunk_rows):
+                        yield slice_table(t, off,
+                                          min(off + chunk_rows, t.num_rows))
+                else:
+                    yield t
+
+    def read_all(self, columns: Optional[Sequence[str]] = None,
+                 row_groups: Optional[Sequence[int]] = None) -> Table:
+        """Materialize (a selection of) the source as one Table, through
+        the admitted read path — the working-set estimate crosses the
+        active DeviceSession's budget like any other op, so an over-budget
+        materialization surfaces as the arbiter's OOM contract instead of
+        an allocator crash."""
+        from ..io import read_parquet as admitted_read
+        return admitted_read(self.source, columns=columns,
+                             row_groups=row_groups)

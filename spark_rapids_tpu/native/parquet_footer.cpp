@@ -375,6 +375,58 @@ class Footer {
     auto* rgs = meta_.field(4);
     return rgs ? int(rgs->elems.size()) : 0;
   }
+
+  // ---- per-row-group / per-chunk statistics (streaming-scan pruning) ----
+  // The generic value tree already round-trips Statistics byte-faithfully;
+  // these accessors read the few fields min/max pruning needs without
+  // giving up the format-agnostic design above.
+  // ColumnMetaData: 1 type, 3 path_in_schema, 7 total_compressed_size,
+  // 12 statistics { 1 max, 2 min, 3 null_count, 5 max_value, 6 min_value }
+  int64_t rg_num_rows(int rg) { return row_group(rg)->field_i(3); }
+  int rg_num_chunks(int rg)
+  {
+    auto* cols = row_group(rg)->field(1);
+    return cols ? int(cols->elems.size()) : 0;
+  }
+  void chunk_info(int rg, int col, std::string& path, int64_t& phys,
+                  int64_t& compressed, int64_t& null_count)
+  {
+    TVal* md = chunk_meta(rg, col);
+    phys = md->field_i(1, -1);
+    compressed = md->field_i(7, 0);
+    path.clear();
+    if (auto* p = md->field(3)) {
+      for (auto& seg : p->elems) {
+        if (!path.empty()) path.push_back('.');
+        path.append(seg.bin);
+      }
+    }
+    null_count = -1;
+    if (auto* st = md->field(12)) {
+      if (auto* nc = st->field(3)) null_count = nc->i;
+    }
+  }
+  // which: 0 = min, 1 = max. Returns false when the stat is absent.
+  bool chunk_stat(int rg, int col, int which, std::string& out)
+  {
+    TVal* md = chunk_meta(rg, col);
+    auto* st = md->field(12);
+    if (!st) return false;
+    // prefer the order-aware v2 fields (min_value/max_value); the
+    // deprecated min/max pair is a fallback for old writers — but ONLY
+    // for numeric types: legacy writers computed byte-array min/max with
+    // SIGNED byte order (the spec says to ignore those), and serving
+    // them as unsigned-order bounds could over-prune matching rows
+    TVal* v = st->field(which == 0 ? 6 : 5);
+    if (!v) {
+      int64_t phys = md->field_i(1, -1);
+      if (phys == 6 || phys == 7) return false;  // BYTE_ARRAY / FLBA
+      v = st->field(which == 0 ? 2 : 1);
+    }
+    if (!v || v->type != CT_BINARY) return false;
+    out = v->bin;
+    return true;
+  }
   int num_top_columns()
   {
     auto& schema = meta_.field(2)->elems;
@@ -394,6 +446,23 @@ class Footer {
  private:
   TVal meta_;
   int next_leaf_ = 0;
+
+  TVal* row_group(int rg)
+  {
+    auto* rgs = meta_.field(4);
+    if (!rgs || rg < 0 || rg >= int(rgs->elems.size()))
+      throw std::runtime_error("row group index out of range");
+    return &rgs->elems[size_t(rg)];
+  }
+  TVal* chunk_meta(int rg, int col)
+  {
+    auto* cols = row_group(rg)->field(1);
+    if (!cols || col < 0 || col >= int(cols->elems.size()))
+      throw std::runtime_error("column chunk index out of range");
+    auto* md = cols->elems[size_t(col)].field(3);
+    if (!md) throw std::runtime_error("column chunk has no metadata");
+    return md;
+  }
 
   static SchemaNode build_node(std::vector<TVal>& schema, int& cursor)
   {
@@ -648,6 +717,66 @@ int pqf_num_row_groups(void* h)
 int pqf_num_columns(void* h)
 {
   return static_cast<Footer*>(h)->num_top_columns();
+}
+
+int64_t pqf_rg_num_rows(void* h, int rg)
+{
+  try {
+    return static_cast<Footer*>(h)->rg_num_rows(rg);
+  } catch (std::exception const& e) {
+    g_error = e.what();
+    return -1;
+  }
+}
+
+int pqf_rg_num_chunks(void* h, int rg)
+{
+  try {
+    return static_cast<Footer*>(h)->rg_num_chunks(rg);
+  } catch (std::exception const& e) {
+    g_error = e.what();
+    return -1;
+  }
+}
+
+int pqf_chunk_info(void* h, int rg, int col, char* path_buf, int64_t cap,
+                   int64_t* phys, int64_t* compressed, int64_t* null_count)
+{
+  try {
+    std::string path;
+    static_cast<Footer*>(h)->chunk_info(rg, col, path, *phys, *compressed,
+                                        *null_count);
+    if (int64_t(path.size()) + 1 > cap) {
+      g_error = "path buffer too small";
+      return 1;
+    }
+    std::memcpy(path_buf, path.c_str(), path.size() + 1);
+    return 0;
+  } catch (std::exception const& e) {
+    g_error = e.what();
+    return 1;
+  }
+}
+
+// >= 0: stat size (bytes written when out != nullptr); -1: stat absent
+// (None-safe path — columns without statistics never prune); -2: error.
+int64_t pqf_chunk_stat(void* h, int rg, int col, int which, uint8_t* out,
+                       int64_t cap)
+{
+  try {
+    std::string v;
+    if (!static_cast<Footer*>(h)->chunk_stat(rg, col, which, v)) return -1;
+    if (out == nullptr) return int64_t(v.size());
+    if (cap < int64_t(v.size())) {
+      g_error = "stat buffer too small";
+      return -2;
+    }
+    std::memcpy(out, v.data(), v.size());
+    return int64_t(v.size());
+  } catch (std::exception const& e) {
+    g_error = e.what();
+    return -2;
+  }
 }
 
 int64_t pqf_serialize(void* h, uint8_t* out, int64_t cap)

@@ -209,12 +209,34 @@ class PlanBuilder:
 
     def scan(self, source: str,
              schema: Optional[Sequence[str]] = None,
-             est_rows: Optional[int] = None) -> Rel:
+             est_rows: Optional[int] = None,
+             parquet=None) -> Rel:
         """`est_rows` is an optional cardinality hint threaded to the
         optimizer's build-side selection; bound tables' actual row counts
-        take precedence at execute()."""
-        return Rel(Scan(source, None if schema is None else tuple(schema),
-                        est_rows=est_rows))
+        take precedence at execute().
+
+        `parquet=` binds the scan to a STREAMING source instead of a
+        materialized Table: a path, whole-file bytes, or an
+        `io.ParquetSource`. The file's schema is read from the footer
+        here, so the subtree validates at build time, and execute() needs
+        no `inputs=` entry for this scan — the executor streams the file
+        morsel-at-a-time through the plan's streamable prefix, pruning
+        row groups against `Scan.predicate` (docs/io.md)."""
+        if parquet is None:
+            return Rel(Scan(source,
+                            None if schema is None else tuple(schema),
+                            est_rows=est_rows))
+        from ..io.parquet import ParquetSource
+        src = (parquet if isinstance(parquet, ParquetSource)
+               else ParquetSource(parquet))
+        if schema is not None and tuple(schema) != tuple(src.names):
+            raise PlanValidationError(
+                f"scan {source!r}: declared schema {list(schema)} does not "
+                f"match the parquet file's {list(src.names)}")
+        return Rel(Scan(source, tuple(src.names),
+                        est_rows=src.num_rows if est_rows is None
+                        else est_rows,
+                        parquet=src))
 
     @staticmethod
     def union(rels: Sequence[Rel]) -> Rel:

@@ -53,6 +53,7 @@ bytes, wall time, retry and cap-escalation counts.
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -104,8 +105,12 @@ def _cpu_device():
 def _table_to_cpu(t: Table, dev) -> Table:
     """Salvage a table onto the CPU backend through host memory (the
     degraded tier's handoff for results computed before the breaker
-    tripped)."""
+    tripped). Streaming source bindings pass through untouched — they are
+    host-side handles the CPU tier re-reads directly."""
     import dataclasses
+
+    if not isinstance(t, Table):
+        return t
 
     def put(a):
         if a is None:
@@ -143,6 +148,146 @@ def _np_dtype_to_dt(np_dt) -> dtypes.DType:
 def _col_from_array(arr) -> Column:
     dt = _np_dtype_to_dt(arr.dtype)
     return Column(dtype=dt, length=int(arr.shape[0]), data=arr)
+
+
+def _input_has_floats(t) -> bool:
+    """Any floating column in a bound Table or streaming source (unknown
+    dtypes count as floats — the conservative direction for every gate
+    that consumes this)."""
+    if isinstance(t, Table):
+        return any(
+            np.issubdtype(np.dtype(c.dtype.storage_dtype()), np.floating)
+            for c in t.columns)
+    return bool(getattr(t, "has_floats", True))
+
+
+# ---- streaming-scan pipeline (docs/io.md) -----------------------------------
+
+class _StreamBreaker(Exception):
+    """A streaming chain hit an unrecoverable fault (breaker tripped):
+    carries the original error plus the retry cost already paid, so the
+    degraded re-run still reports it."""
+
+    def __init__(self, error, retries: int, backoff_ms: float):
+        super().__init__(str(error))
+        self.error = error
+        self.retries = retries
+        self.backoff_ms = backoff_ms
+
+
+class _SyncFeed:
+    """Prefetch disabled (SPARK_RAPIDS_TPU_IO_PREFETCH=0): decode inline
+    on the executing thread. Same surface as _ChunkPrefetcher."""
+
+    def __init__(self, gen):
+        self._gen = gen
+        self.decode_intervals = []
+        self.decode_ms = 0.0
+
+    def get(self):
+        t0 = time.perf_counter()
+        try:
+            chunk = next(self._gen)
+        except StopIteration:
+            return None
+        t1 = time.perf_counter()
+        self.decode_intervals.append((t0, t1))
+        self.decode_ms += (t1 - t0) * 1e3
+        return chunk
+
+    def close(self):
+        self._gen.close()
+
+
+class _ChunkPrefetcher:
+    """Bounded host-side prefetch thread: decodes chunk N+1 (up to `depth`
+    ahead) while the consumer executes chunk N — the double-buffer that
+    overlaps host bitstream decode with device execution (StreamBox-HBM's
+    pipelined-chunk shape; the native decode releases the GIL, so the
+    overlap is real CPU concurrency, not just queueing)."""
+
+    _DONE = object()
+
+    def __init__(self, gen, depth: int):
+        import queue
+        self._gen = gen
+        self._q = queue.Queue(maxsize=max(1, depth))
+        self._stop = False
+        self._err = None
+        self.decode_intervals = []      # (start, end) per decoded chunk
+        self.decode_ms = 0.0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="spark-rapids-tpu-io-prefetch")
+        self._thread.start()
+
+    def _run(self):
+        try:
+            while not self._stop:
+                t0 = time.perf_counter()
+                try:
+                    chunk = next(self._gen)
+                except StopIteration:
+                    break
+                t1 = time.perf_counter()
+                self.decode_intervals.append((t0, t1))
+                self.decode_ms += (t1 - t0) * 1e3
+                self._q.put(chunk)
+        except BaseException as e:       # surfaces at the consumer's get()
+            self._err = e
+        finally:
+            self._q.put(self._DONE)
+
+    def get(self):
+        """Next decoded chunk, or None at end of stream. Re-raises a
+        decode-thread error on the consumer thread."""
+        item = self._q.get()
+        if item is self._DONE:
+            if self._err is not None:
+                raise self._err
+            return None
+        return item
+
+    def close(self):
+        """Unblock and retire the decode thread (consumer aborted early, or
+        end-of-stream cleanup): keep draining until the thread exits so a
+        put() blocked on a full queue always wakes."""
+        import queue
+        self._stop = True
+        while self._thread.is_alive():
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+        try:
+            self._gen.close()   # release the reader (mmap/file handle) now,
+        except Exception:       # not at GC — the degraded tier may be about
+            pass                # to re-open the same file
+
+
+def _interval_overlap_ms(decode, process) -> float:
+    """Total wall time decode intervals and processing intervals ran
+    concurrently — the prefetch pipeline's measured win. Linear merge:
+    each list is chronological and internally non-overlapping (sequential
+    decode, sequential execution)."""
+    total = 0.0
+    i = j = 0
+    while i < len(decode) and j < len(process):
+        s1, e1 = decode[i]
+        s2, e2 = process[j]
+        total += max(0.0, min(e1, e2) - max(s1, s2))
+        if e1 < e2:
+            i += 1
+        else:
+            j += 1
+    return total * 1e3
+
+
+# HashAggregate ops that decompose into per-chunk partials + an exact
+# merge over the partial rows (count/size merge by summing counts)
+_STREAM_AGG_MERGE = {"sum": "sum", "count": "sum", "size": "sum",
+                     "min": "min", "max": "max"}
 
 
 class PlanResult:
@@ -264,13 +409,21 @@ class PlanExecutor:
         self._caps_memo: Dict[str, Dict[str, int]] = _LruDict(256)
 
     # ---- entry point ------------------------------------------------------
-    def execute(self, plan: Plan, inputs: Dict[str, Table]) -> PlanResult:
+    def execute(self, plan: Plan,
+                inputs: Optional[Dict[str, Table]] = None) -> PlanResult:
+        # a Scan carrying its own parquet binding needs no inputs= entry;
+        # an explicit entry (Table or source) for the same name wins
+        inputs = dict(inputs or {})
+        for s in plan.scans:
+            if s.source not in inputs and s.parquet is not None:
+                inputs[s.source] = s.parquet
         missing = [s for s in plan.input_names if s not in inputs]
         if missing:
             raise PlanValidationError(f"unbound plan input(s) {missing}")
         # full validation against the bound tables' actual schemas —
         # authored-plan errors surface against authored labels, BEFORE any
-        # optimizer rewrite renames nodes
+        # optimizer rewrite renames nodes (streaming sources expose .names
+        # from the parquet footer, so the same contract applies)
         bound = {name: tuple(t.names) for name, t in inputs.items()}
         schemas = plan.resolve_schemas(bound)
         report = None
@@ -296,17 +449,19 @@ class PlanExecutor:
         # is part of the cache KEY — a rewrite computed from integer
         # inputs must not be served to a float binding of the same
         # names/shapes (the gate would be bypassed by the cache hit)
-        floats = any(
-            np.issubdtype(np.dtype(c.dtype.storage_dtype()), np.floating)
-            for t in inputs.values() for c in t.columns)
+        floats = any(_input_has_floats(t) for t in inputs.values())
+        # scans bound to streaming sources: the scan_pruning rule fires
+        # only for these, so the set belongs in the cache key too
+        streaming = frozenset(n for n, t in inputs.items()
+                              if not isinstance(t, Table))
         key = (plan.root, tuple(sorted(bound.items())),
                tuple(sorted((n, t.num_rows) for n, t in inputs.items())),
-               floats)
+               floats, streaming)
         hit = self._opt_cache.get(key)
         if hit is None:
             opt, report = run_optimizer(
                 plan, bound, {n: t.num_rows for n, t in inputs.items()},
-                float_inputs=floats)
+                float_inputs=floats, streaming_sources=streaming)
             hit = (opt, opt.resolve_schemas(bound), report)
             self._opt_cache[key] = hit
         return hit
@@ -412,8 +567,34 @@ class PlanExecutor:
             return self._execute_degraded(plan, inputs, schemas, results,
                                           metrics, start=0, t_plan0=t_plan0,
                                           mode="eager")
+        # streamable prefixes over source-bound scans run morsel-at-a-time
+        # (decode chunk N+1 on host while chunk N executes); their interior
+        # nodes never materialize a whole relation, only the chain tail does
+        chains = self._stream_chains(plan, inputs)
+        chain_interior = {id(n) for ch in chains.values() for n in ch[:-1]}
+        node_index = {id(n): i for i, n in enumerate(plan.nodes)}
         try:
             for i, node in enumerate(plan.nodes):
+                if id(node) in chain_interior:
+                    continue        # runs inside its chain, at the tail
+                if id(node) in chains:
+                    chain = chains[id(node)]
+                    try:
+                        out = self._exec_stream_chain(chain, inputs,
+                                                      schemas, metrics)
+                    except _StreamBreaker as sb:
+                        if self.degrade == "off":
+                            raise sb.error
+                        # replay the chain's remaining chunks — and the
+                        # whole prefix — on the CPU tier from the scan
+                        return self._execute_degraded(
+                            plan, inputs, schemas, results, metrics,
+                            start=node_index[id(chain[0])],
+                            t_plan0=t_plan0, mode="eager",
+                            carry_retries=sb.retries,
+                            carry_backoff_ms=sb.backoff_ms)
+                    results[id(node)] = out
+                    continue
                 child_tables = [results[id(c)] for c in node.children]
                 m = OperatorMetrics(label=node.label, kind=node.kind,
                                     describe=node.describe())
@@ -559,12 +740,249 @@ class PlanExecutor:
                           backoff_ms=carry_backoff_ms + sum(
                               mm.backoff_ms for mm in metrics.values()))
 
+    # ---- streaming prefix (docs/io.md) ------------------------------------
+    @staticmethod
+    def _stream_chains(plan, inputs) -> Dict[int, List[PlanNode]]:
+        """id(tail) -> [Scan, op, ...] streamable prefixes. A chain starts
+        at a Scan bound to a streaming source and extends while the node
+        has exactly ONE consumer that is a row-wise Filter/Project/
+        FusedSelect (no scalar aggregates — those reduce over the whole
+        relation); it may terminate INTO a HashAggregate whose ops
+        decompose exactly (sum/count/min/max/size over non-float inputs —
+        fp partial sums are not reorder-exact). Everything else is the
+        concat boundary: the tail materializes one Table and the rest of
+        the plan proceeds normally."""
+        from .expr import has_scalar_agg
+        parents: Dict[int, List[PlanNode]] = {}
+        for n in plan.nodes:
+            for c in n.children:
+                parents.setdefault(id(c), []).append(n)
+        chains: Dict[int, List[PlanNode]] = {}
+        for scan in plan.scans:
+            src = inputs.get(scan.source)
+            if src is None or isinstance(src, Table) or \
+                    not getattr(src, "is_streaming_source", False):
+                continue
+            chain = [scan]
+            node: PlanNode = scan
+            while True:
+                ps = parents.get(id(node), [])
+                if len(ps) != 1:
+                    break
+                p = ps[0]
+                if isinstance(p, Filter) and \
+                        not has_scalar_agg(p.predicate):
+                    chain.append(p)
+                    node = p
+                    continue
+                if isinstance(p, Project) and not any(
+                        has_scalar_agg(e) for _, e in p.exprs):
+                    chain.append(p)
+                    node = p
+                    continue
+                if isinstance(p, FusedSelect) and \
+                        not has_scalar_agg(p.predicate) and not any(
+                            has_scalar_agg(e) for _, e in p.exprs):
+                    chain.append(p)
+                    node = p
+                    continue
+                if (isinstance(p, HashAggregate)
+                        and all(o in _STREAM_AGG_MERGE
+                                for _, o, _ in p.aggs)
+                        and not _input_has_floats(src)):
+                    chain.append(p)     # terminal: partial accumulation
+                break
+            if len(chain) > 1:
+                chains[id(chain[-1])] = chain
+        return chains
+
+    def _stream_op(self, node, t: Table, inputs, schemas,
+                   m: OperatorMetrics, fn=None) -> Table:
+        """One chain operator over one chunk, with the same per-op fault
+        policy as the materialized path (backoff-paced retries; a breaker
+        trip raises _StreamBreaker so the caller can degrade)."""
+        from ..utils import tracing
+        attempt = 0
+        while True:
+            t0 = time.perf_counter()
+            try:
+                with tracing.range_ctx(f"plan.{node.label}"):
+                    self._faultinj_point(node)
+                    out = (fn(t) if fn is not None else
+                           self._exec_eager_node(node, [t], inputs,
+                                                 schemas, m))
+                break
+            except _fault_surface() as err:
+                if self._handle_fault(err, node.label, attempt, m):
+                    attempt += 1
+                    continue
+                raise _StreamBreaker(err, m.retries, m.backoff_ms)
+        if attempt:
+            self.health.record_success(node.label)
+        m.wall_ms = (m.wall_ms or 0.0) + (time.perf_counter() - t0) * 1e3
+        m.rows_in += t.num_rows
+        m.rows_out += out.num_rows
+        return out
+
+    def _exec_stream_chain(self, chain, inputs, schemas,
+                           metrics: Dict[str, OperatorMetrics]) -> Table:
+        """Run one streamable prefix morsel-at-a-time: row-group pruning at
+        the scan, bounded host prefetch decoding chunk N+1 while chunk N
+        executes, per-chunk Filter/Project/FusedSelect, and partial
+        HashAggregate accumulation merged exactly at the end. Fills
+        `metrics` for every chain node; returns the tail's Table."""
+        from .. import config
+        from .optimizer import pruning_conjuncts
+        from ..runtime.admission import operand_nbytes
+        ops = _ops()
+        scan = chain[0]
+        src = inputs[scan.source]
+        ms = {n.label: OperatorMetrics(label=n.label, kind=n.kind,
+                                       describe=n.describe())
+              for n in chain}
+        sm = ms[scan.label]
+        columns = (list(scan.projection) if scan.projection is not None
+                   else None)
+        conjuncts = (pruning_conjuncts(scan.predicate)
+                     if scan.predicate is not None else [])
+        kept, pruned, skipped = src.select_groups(conjuncts, columns)
+        sm.io_row_groups_total = src.num_row_groups
+        sm.io_row_groups_pruned = pruned
+        sm.io_bytes_skipped = skipped
+        agg = chain[-1] if isinstance(chain[-1], HashAggregate) else None
+        body = chain[1:-1] if agg is not None else chain[1:]
+        chunk_rows = src.chunk_rows or config.io_chunk_rows() or None
+        depth = config.io_prefetch()
+        gen = src.chunks(columns=columns, row_groups=kept,
+                         chunk_rows=chunk_rows)
+        feed = _ChunkPrefetcher(gen, depth) if depth > 0 else _SyncFeed(gen)
+        parts: List[Table] = []         # tail outputs (or agg partials)
+        empty_t: Optional[Table] = None
+        proc_intervals = []
+        try:
+            while True:
+                chunk = feed.get()
+                if chunk is None:
+                    break
+                t0p = time.perf_counter()
+                sm.rows_out += chunk.num_rows
+                sm.bytes_out += operand_nbytes(chunk)
+                t = chunk
+                for node in body:
+                    t = self._stream_op(node, t, inputs, schemas,
+                                        ms[node.label])
+                    ms[node.label].bytes_out += operand_nbytes(t)
+                if agg is not None:
+                    if t.num_rows == 0:
+                        # fully-filtered morsel: contributes nothing, and a
+                        # keyless min/max over a ZERO-ROW frame would raise
+                        # where the table-bound plan (reducing over the
+                        # whole non-empty relation) succeeds — skip it,
+                        # keeping one empty frame for the all-empty case
+                        empty_t = t
+                        proc_intervals.append((t0p, time.perf_counter()))
+                        continue
+                    t = self._stream_op(
+                        agg, t, inputs, schemas, ms[agg.label],
+                        fn=lambda tt: self._stream_partial_agg(agg, tt,
+                                                               schemas))
+                parts.append(t)
+                if self.block_per_op:
+                    jax.block_until_ready([c.data for c in t.columns])
+                proc_intervals.append((t0p, time.perf_counter()))
+        finally:
+            feed.close()
+        sm.io_decode_ms = feed.decode_ms
+        sm.io_overlap_ms = _interval_overlap_ms(feed.decode_intervals,
+                                                proc_intervals)
+        sm.wall_ms = feed.decode_ms     # scan wall = host decode
+        # concatenate ONLY at the first non-streamable boundary
+        tail = chain[-1]
+        tm = ms[tail.label]
+        t0 = time.perf_counter()
+        if agg is not None:
+            if not parts:
+                # every morsel filtered to zero rows: aggregate the empty
+                # frame once — identical semantics (including any keyless
+                # min/max error) to the table-bound plan over an empty
+                # filtered relation
+                parts = [self._stream_op(
+                    agg, empty_t, inputs, schemas, ms[agg.label],
+                    fn=lambda tt: self._stream_partial_agg(agg, tt,
+                                                           schemas))]
+            out = self._finalize_stream_agg(agg, parts, schemas)
+            tm.rows_out = out.num_rows  # partial rows were internal
+        else:
+            out = parts[0] if len(parts) == 1 else ops.concat_tables(parts)
+        if self.block_per_op:
+            jax.block_until_ready([c.data for c in out.columns])
+        tm.wall_ms = (tm.wall_ms or 0.0) + (time.perf_counter() - t0) * 1e3
+        tm.bytes_out = operand_nbytes(out)
+        for n in chain:
+            metrics[n.label] = ms[n.label]
+        return out
+
+    def _stream_partial_agg(self, node: HashAggregate, t: Table,
+                            schemas) -> Table:
+        """Per-chunk partial aggregation (named like the final schema, so
+        the merge groups on the output columns)."""
+        ops = _ops()
+        if not node.keys:
+            return self._global_aggregate(t, node)
+        agg = ops.groupby_aggregate(t, list(node.keys),
+                                    [(c, o) for c, o, _ in node.aggs])
+        return Table(list(agg.columns), names=schemas[id(node)])
+
+    def _finalize_stream_agg(self, node: HashAggregate,
+                             partials: List[Table], schemas) -> Table:
+        """Exact merge of per-chunk partials: counts sum, sums sum, min/max
+        re-reduce — the same two-phase shape as the distributed tier, over
+        chunks instead of mesh peers. The sort-based groupby kernel's
+        key-ordered output makes the merged result row-identical to the
+        single-pass aggregate."""
+        ops = _ops()
+        cat = (partials[0] if len(partials) == 1
+               else ops.concat_tables(partials))
+        merged_aggs = tuple((out, _STREAM_AGG_MERGE[o], out)
+                            for _, o, out in node.aggs)
+        if not node.keys:
+            merge_node = HashAggregate(node.child, (), merged_aggs)
+            return self._global_aggregate(cat, merge_node)
+        agg = ops.groupby_aggregate(cat, list(node.keys),
+                                    [(c, o) for c, o, _ in merged_aggs])
+        return Table(list(agg.columns), names=schemas[id(node)])
+
+    def _materialize_scan(self, node: Scan, src,
+                          m: Optional[OperatorMetrics]) -> Table:
+        """Source-bound Scan outside a streamable prefix (shared scans,
+        join inputs, the capped tier): one admitted read, still with
+        selective decode (projection columns only) and stats-driven
+        row-group pruning."""
+        from .optimizer import pruning_conjuncts
+        columns = (list(node.projection) if node.projection is not None
+                   else None)
+        conjuncts = (pruning_conjuncts(node.predicate)
+                     if node.predicate is not None else [])
+        kept, pruned, skipped = src.select_groups(conjuncts, columns)
+        t0 = time.perf_counter()
+        t = src.read_all(columns=columns, row_groups=kept)
+        if m is not None:
+            m.io_row_groups_total = src.num_row_groups
+            m.io_row_groups_pruned = pruned
+            m.io_bytes_skipped = skipped
+            m.io_decode_ms += (time.perf_counter() - t0) * 1e3
+        return t
+
     def _exec_eager_node(self, node, childs: List[Table], inputs, schemas,
                          m: OperatorMetrics,
                          allow_mesh: bool = True) -> Table:
         ops = _ops()
         if isinstance(node, Scan):
             t = inputs[node.source]
+            if not isinstance(t, Table):
+                # streaming source outside a streamable prefix: materialize
+                # (pruned + projected) in one read
+                return self._materialize_scan(node, t, m)
             if node.projection is not None:
                 # pruned scan: unused columns never enter the plan
                 t = t.select(list(node.projection))
@@ -786,6 +1204,27 @@ class PlanExecutor:
 
     def _execute_capped(self, plan, inputs, schemas) -> PlanResult:
         from ..parallel.autoretry import auto_retry_overflow
+        # the capped tier traces ONE whole-plan program over concrete
+        # shapes, so streaming sources materialize first — still through
+        # the pruned/projected read, so the decode savings carry over
+        scan_io: Dict[str, OperatorMetrics] = {}
+        if any(not isinstance(t, Table) for t in inputs.values()):
+            inputs = dict(inputs)
+            # one Scan per source is a Plan invariant (Plan.__init__
+            # rejects duplicate sources), so materializing per NAME with
+            # that scan's projection/predicate loses nothing
+            by_source = {n.source: n for n in plan.nodes
+                         if isinstance(n, Scan)}
+            for name, v in list(inputs.items()):
+                if isinstance(v, Table):
+                    continue
+                node = by_source.get(name)
+                holder = OperatorMetrics(label=name, kind="Scan")
+                if node is not None:
+                    inputs[name] = self._materialize_scan(node, v, holder)
+                else:
+                    inputs[name] = v.read_all()
+                scan_io[name] = holder
         # start from the input-derived defaults, floored up by any caps the
         # plan already escalated to: the memo must never UNDERSIZE a run on
         # larger inputs than it was learned on (only skip re-learning)
@@ -883,6 +1322,13 @@ class PlanExecutor:
                 rows_in=rows_in, rows_out=rows_out,
                 bytes_out=bytes_map.get(i, 0),
                 escalations=escal if uses_cap else 0)
+            if isinstance(node, Scan) and node.source in scan_io:
+                io = scan_io[node.source]
+                mm = metrics[node.label]
+                mm.io_row_groups_total = io.io_row_groups_total
+                mm.io_row_groups_pruned = io.io_row_groups_pruned
+                mm.io_bytes_skipped = io.io_bytes_skipped
+                mm.io_decode_ms = io.io_decode_ms
         return PlanResult(plan, table, valid, metrics, "capped", wall,
                           attempts=attempts, caps=final_caps,
                           retries=retries,

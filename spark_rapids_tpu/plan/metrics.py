@@ -31,6 +31,15 @@ class OperatorMetrics:
     escalations: int = 0       # cap-growth retries charged to this node
     backoff_ms: float = 0.0    # time spent backing off before retries
     degraded: bool = False     # ran on the degraded CPU tier (breaker open)
+    # streaming-scan IO metrics (Scan nodes bound to a parquet source;
+    # docs/io.md). Decode wall is host-side bitstream decode; overlap is
+    # the time decode of chunk N+1 ran concurrently with executing chunk N
+    # (the prefetch pipeline's win — 0 with SPARK_RAPIDS_TPU_IO_PREFETCH=0).
+    io_row_groups_total: int = 0
+    io_row_groups_pruned: int = 0
+    io_bytes_skipped: int = 0      # compressed chunk bytes never decoded
+    io_decode_ms: float = 0.0
+    io_overlap_ms: float = 0.0
 
     def to_dict(self) -> Dict:
         return dataclasses.asdict(self)
@@ -77,4 +86,11 @@ def render_profile(rows: List[OperatorMetrics],
                    f"{m.bytes_out:>12} {wall:>9} {m.retries:>5} "
                    f"{m.escalations:>5} {m.backoff_ms:>8.1f} "
                    f"{'yes' if m.degraded else '-':>4}")
+        if m.io_row_groups_total:
+            kept = m.io_row_groups_total - m.io_row_groups_pruned
+            out.append(f"  io: row groups {kept}/{m.io_row_groups_total} "
+                       f"({m.io_row_groups_pruned} pruned), "
+                       f"{m.io_bytes_skipped} B skipped, "
+                       f"decode {m.io_decode_ms:.3f} ms, "
+                       f"overlap {m.io_overlap_ms:.3f} ms")
     return "\n".join(out)

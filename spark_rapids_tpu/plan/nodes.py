@@ -64,17 +64,26 @@ class PlanNode:
 
 @dataclasses.dataclass(frozen=True, eq=False)
 class Scan(PlanNode):
-    """Leaf: one named input relation, bound to a concrete Table at
-    execute() (`inputs={name: table}`). A declared `schema` validates at
-    build time and is checked against the bound table. `projection`
-    (set by the optimizer's column-pruning rule) narrows the output to a
-    subset of the bound columns — unpruned columns never enter the plan.
-    `est_rows` is an optional cardinality hint for the optimizer's
-    build-side selection when no table is bound yet."""
+    """Leaf: one named input relation, bound at execute() to a concrete
+    Table (`inputs={name: table}`) or a streaming source (an
+    `io.ParquetSource`, either via `inputs=` or attached here as
+    `parquet` by `PlanBuilder.scan(parquet=...)`). A declared `schema`
+    validates at build time and is checked against the binding.
+    `projection` (set by the optimizer's column-pruning rule) narrows the
+    output to a subset of the bound columns — unpruned columns never
+    enter the plan; on a parquet source they are never even DECODED.
+    `predicate` (set by the optimizer's scan_pruning rule) is a
+    PRUNING-ONLY hint: row groups whose footer min/max statistics prove
+    it matches nothing are skipped, while the authoring Filter stays
+    above for exact semantics — it never changes the result, only the
+    bytes decoded. `est_rows` is an optional cardinality hint for the
+    optimizer's build-side selection when no table is bound yet."""
     source: str
     schema: Optional[Tuple[str, ...]] = None
     projection: Optional[Tuple[str, ...]] = None
     est_rows: Optional[int] = None
+    predicate: Optional[Expr] = None
+    parquet: Optional[object] = None    # io.ParquetSource (not fingerprinted)
 
     def __post_init__(self):
         super().__post_init__()
@@ -87,6 +96,13 @@ class Scan(PlanNode):
         _require(self.schema is not None,
                  f"{self.label}: schema for input {self.source!r} is unknown "
                  "(declare it at scan() or bind inputs)")
+        if self.predicate is not None:
+            # pruning predicates compare FILE columns (they need not be
+            # projected: stats come from the footer, not decoded data)
+            missing = self.predicate.references() - set(self.schema)
+            _require(not missing,
+                     f"{self.label}: pruning predicate references unknown "
+                     f"column(s) {sorted(missing)}")
         return self.apply_projection(self.schema)
 
     def apply_projection(self, schema) -> Tuple[str, ...]:
@@ -100,9 +116,14 @@ class Scan(PlanNode):
         return self.projection
 
     def describe(self):
+        out = self.source
+        if self.parquet is not None:
+            out += " (parquet)"
         if self.projection is not None:
-            return f"{self.source} [{', '.join(self.projection)}]"
-        return self.source
+            out += f" [{', '.join(self.projection)}]"
+        if self.predicate is not None:
+            out += f" prune[{self.predicate!r}]"
+        return out
 
 
 @dataclasses.dataclass(frozen=True, eq=False)

@@ -70,7 +70,7 @@ from .nodes import (Exchange, Filter, FusedSelect, HashAggregate, HashJoin,
                     Sort, TopK, Union)
 
 __all__ = ["optimize", "plan_fingerprint", "OptimizeReport", "RULE_NAMES",
-           "MAX_PASSES"]
+           "MAX_PASSES", "pruning_conjuncts", "split_conjuncts"]
 
 MAX_PASSES = 10           # fixpoint guard: rewrite passes, not rewrites
 _EST_BYTES_PER_CELL = 8   # the engine's INT64-tier column width
@@ -78,8 +78,10 @@ _EST_BYTES_PER_CELL = 8   # the engine's INT64-tier column width
 
 # ---- fingerprint ------------------------------------------------------------
 
-# pure hints that do not change the program a plan compiles to
-_FP_SKIP_FIELDS = {"est_rows"}
+# pure hints that do not change the program a plan compiles to — plus the
+# attached streaming source object (its identity is execution state, not
+# plan structure; shapes/names already key the executor's program cache)
+_FP_SKIP_FIELDS = {"est_rows", "parquet"}
 
 
 def _fp_expr(e: Expr) -> Tuple:
@@ -140,7 +142,52 @@ def plan_fingerprint(plan: Plan) -> str:
 # ---- report -----------------------------------------------------------------
 
 RULE_NAMES = ("constant_folding", "predicate_pushdown", "limit_pushdown",
-              "build_side", "column_pruning", "select_fusion")
+              "build_side", "column_pruning", "select_fusion",
+              "scan_pruning")
+
+
+# ---- pruning-conjunct extraction (shared with the executor's scan IO) -------
+
+# comparison ops a row group's min/max range can prove empty
+_PRUNE_OPS = ("<", "<=", ">", ">=", "==")
+_FLIP_OP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "=="}
+
+
+def split_conjuncts(e: Expr) -> List[Expr]:
+    """Top-level AND conjuncts of a predicate (the predicate itself when
+    its root is not `&`)."""
+    if isinstance(e, BinOp) and e.op == "&":
+        return split_conjuncts(e.left) + split_conjuncts(e.right)
+    return [e]
+
+
+def _as_comparison(e: Expr) -> Optional[Tuple[str, str, object]]:
+    """`col <op> literal` (either orientation) as (name, op, value); None
+    for any other shape — an OR, a column-column compare, arithmetic, a
+    scalar aggregate — which min/max stats cannot prove anything about."""
+    if not isinstance(e, BinOp) or e.op not in _PRUNE_OPS:
+        return None
+    l, r = e.left, e.right
+    if isinstance(l, ColumnRef) and isinstance(r, Literal):
+        return (l.name, e.op, r.value)
+    if isinstance(r, ColumnRef) and isinstance(l, Literal):
+        return (r.name, _FLIP_OP[e.op], l.value)
+    return None
+
+
+def pruning_conjuncts(e: Expr) -> List[Tuple[str, str, object]]:
+    """The (column, op, literal) triples of `e`'s top-level AND conjuncts
+    that row-group min/max statistics can evaluate. Pruning on this SUBSET
+    of an AND is always conservative-exact (every extracted conjunct must
+    hold for a row to survive the retained Filter); a non-conjunct shape —
+    e.g. an OR at the top level — contributes nothing, so the scan_pruning
+    rule declines rather than over-prunes."""
+    out = []
+    for c in split_conjuncts(e):
+        cmp = _as_comparison(c)
+        if cmp is not None:
+            out.append(cmp)
+    return out
 
 
 @dataclasses.dataclass
@@ -311,12 +358,14 @@ class _Estimator:
 
 class _Ctx:
     def __init__(self, root, bound, bound_rows, report,
-                 float_inputs=False):
+                 float_inputs=False, streaming=frozenset()):
         self.schemas = _Schemas(bound)
         self.est = _Estimator(bound_rows)
         self.shared = _shared_ids(root)
         self.report = report
         self.float_inputs = float_inputs
+        self.streaming = streaming      # scan sources bound to streaming
+        #                                 (parquet) sources this execution
 
 
 def _rule_constant_folding(root, ctx):
@@ -660,6 +709,44 @@ def _rule_column_pruning(root, ctx):
     return go(root), hits[0]
 
 
+def _rule_scan_pruning(root, ctx):
+    """Filter/FusedSelect directly over a streaming-source Scan: lower the
+    min/max-provable AND-conjuncts of the predicate into `Scan.predicate`
+    for row-group pruning. PRUNING-ONLY: the Filter/FusedSelect stays
+    above for exact row semantics; a row group is skipped at scan time
+    only when footer statistics prove the lowered conjuncts match nothing
+    (io/parquet.select_row_groups). Predicates with no provable top-level
+    conjunct — an OR at the root, column-column compares, scalar
+    aggregates — lower nothing: extracting from inside an OR would
+    over-prune rows the retained Filter still wants."""
+    hits = [0]
+
+    def fn(node):
+        if not isinstance(node, (Filter, FusedSelect)):
+            return None
+        child = node.child
+        if not isinstance(child, Scan) or child.predicate is not None:
+            return None
+        if child.parquet is None and child.source not in ctx.streaming:
+            return None     # table-bound scan: nothing to prune at IO time
+        if id(child) in ctx.shared:
+            # a shared scan feeds OTHER parents that did not author this
+            # filter — pruning it would starve them of rows
+            return None
+        safe = [c for c in split_conjuncts(node.predicate)
+                if _as_comparison(c) is not None]
+        if not safe:
+            return None
+        pred = safe[0]
+        for c in safe[1:]:
+            pred = BinOp("&", pred, c)
+        hits[0] += 1
+        return _with_children(
+            node, (dataclasses.replace(child, predicate=pred),))
+
+    return _rewrite(root, fn, ctx.shared), hits[0]
+
+
 _RULES = (
     ("constant_folding", _rule_constant_folding),
     ("predicate_pushdown", _rule_predicate_pushdown),
@@ -667,6 +754,7 @@ _RULES = (
     ("build_side", _rule_build_side),
     ("column_pruning", _rule_column_pruning),
     ("select_fusion", _rule_select_fusion),
+    ("scan_pruning", _rule_scan_pruning),
 )
 
 
@@ -676,21 +764,28 @@ def optimize(plan: Plan,
              bound: Optional[Dict[str, Tuple[str, ...]]] = None,
              bound_rows: Optional[Dict[str, int]] = None,
              max_passes: int = MAX_PASSES,
-             float_inputs: bool = False) -> Tuple[Plan, OptimizeReport]:
+             float_inputs: bool = False,
+             streaming_sources=frozenset()) -> Tuple[Plan, OptimizeReport]:
     """Run the rule pipeline to fixpoint over `plan`. `bound` maps scan
     source -> actual column names and `bound_rows` -> actual row counts
     (execute() passes both; explain-time callers may pass neither and the
     schema/estimate-dependent rules degrade gracefully). `float_inputs`
     disables the build_side rule (execute() sets it when any bound column
-    is floating point — fp reductions are not reorder-exact). Returns the
-    optimized Plan (the SAME object when nothing fired) + the report."""
+    is floating point — fp reductions are not reorder-exact).
+    `streaming_sources` names the scans bound to streaming (parquet)
+    sources this execution — the scan_pruning rule fires only for those
+    (a Scan carrying its own `parquet` binding qualifies regardless).
+    Returns the optimized Plan (the SAME object when nothing fired) + the
+    report."""
     report = OptimizeReport(rules={name: 0 for name, _ in _RULES})
     report.source_fingerprint = plan.fingerprint
+    streaming = frozenset(streaming_sources)
     root = plan.root
     for p in range(max_passes):
         pass_hits = 0
         for name, rule in _RULES:
-            ctx = _Ctx(root, bound, bound_rows, report, float_inputs)
+            ctx = _Ctx(root, bound, bound_rows, report, float_inputs,
+                       streaming)
             root, n = rule(root, ctx)
             report.rules[name] += n
             pass_hits += n

@@ -197,3 +197,107 @@ def test_garbage_buffer_raises():
     with pytest.raises(ValueError):
         ParquetFooter.read_and_filter(b"\x99\x88\x77", 0, 10,
                                       StructElement(a=ValueElement()), False)
+
+
+# ---- per-row-group min/max statistics (read_footer_stats) -------------------
+
+def test_footer_stats_per_group_minmax():
+    from spark_rapids_tpu.io import read_footer_stats
+    data = write_parquet(simple_table(4000), row_group_size=1000)
+    stats = read_footer_stats(data)
+    assert len(stats) == 4
+    for g, rg in enumerate(stats):
+        assert rg.index == g
+        assert rg.num_rows == 1000
+        a = rg.columns["a"]
+        assert (a.min, a.max) == (g * 1000, g * 1000 + 999)
+        assert a.null_count == 0
+        assert a.total_compressed_size > 0
+        c = rg.columns["c"]
+        assert c.min == pytest.approx(g * 1000 * 0.5)
+        assert c.max == pytest.approx((g * 1000 + 999) * 0.5)
+        b = rg.columns["b"]            # strings: bytes min/max
+        assert isinstance(b.min, bytes) and b.min.startswith(b"s")
+    # oracle: pyarrow reads the same statistics back
+    md = pq.read_metadata(io.BytesIO(data))
+    st = md.row_group(2).column(0).statistics
+    assert (stats[2].columns["a"].min, stats[2].columns["a"].max) == \
+        (st.min, st.max)
+
+
+def test_footer_stats_none_safe_without_statistics():
+    """A file written without statistics surfaces min/max as None (the
+    'cannot prove anything' state pruning must honor) instead of raising."""
+    from spark_rapids_tpu.io import read_footer_stats
+    sink = io.BytesIO()
+    pq.write_table(simple_table(100), sink, compression="NONE",
+                   write_statistics=False)
+    stats = read_footer_stats(sink.getvalue())
+    assert len(stats) == 1
+    for st in stats[0].columns.values():
+        assert st.min is None and st.max is None
+        assert st.total_compressed_size > 0
+
+
+def test_footer_stats_nested_paths_and_file_source(tmp_path):
+    """Nested leaves key by dotted path; a path source reads only the
+    footer tail (no whole-file load)."""
+    from spark_rapids_tpu.io import read_footer_stats
+    table = pa.table({
+        "s": pa.array([{"x": i, "y": float(i)} for i in range(50)]),
+        "p": pa.array(range(50)),
+    })
+    path = tmp_path / "nested.parquet"
+    pq.write_table(table, path, compression="NONE")
+    stats = read_footer_stats(str(path))
+    cols = stats[0].columns
+    assert cols["s.x"].min == 0 and cols["s.x"].max == 49
+    assert cols["s.y"].max == pytest.approx(49.0)
+    assert cols["p"].column == "p" and cols["s.x"].column == "s"
+
+
+def test_footer_stats_garbage_raises():
+    from spark_rapids_tpu.io import read_footer_stats
+    with pytest.raises(ValueError):
+        read_footer_stats(b"\x00" * 64)
+
+
+def test_select_row_groups_pruning_is_conservative():
+    """select_row_groups drops a group only on PROOF of emptiness; missing
+    stats, nulls, and type mismatches keep the group."""
+    from spark_rapids_tpu.io import read_footer_stats, select_row_groups
+    data = write_parquet(simple_table(4000), row_group_size=1000)
+    stats = read_footer_stats(data)
+    # a in [0, 4000): a < 1500 keeps groups 0-1
+    kept, pruned = select_row_groups(stats, [("a", "<", 1500)], 4)
+    assert (kept, pruned) == ([0, 1], 2)
+    kept, pruned = select_row_groups(stats, [("a", ">=", 3000)], 4)
+    assert (kept, pruned) == ([3], 3)
+    kept, pruned = select_row_groups(stats, [("a", "==", 2500)], 4)
+    assert (kept, pruned) == ([2], 3)
+    # conjuncts AND together
+    kept, pruned = select_row_groups(
+        stats, [("a", ">=", 1000), ("a", "<", 2000)], 4)
+    assert (kept, pruned) == ([1], 3)
+    # string conjunct compares as bytes
+    kept, _ = select_row_groups(stats, [("b", "==", "s1500")], 4)
+    assert 1 in kept
+    # unknown column / no stats / None stats: keep everything
+    assert select_row_groups(stats, [("zz", "<", 0)], 4)[1] == 0
+    assert select_row_groups(None, [("a", "<", 0)], 4) == (list(range(4)), 0)
+    # type mismatch (string literal vs int column): keep everything
+    assert select_row_groups(stats, [("a", "<", "x")], 4)[1] == 0
+
+
+def test_select_row_groups_null_groups_never_prune():
+    """min/max statistics exclude nulls, but null rows carry fill values
+    the row-wise Filter still sees — a group with nulls must not prune."""
+    from spark_rapids_tpu.io import read_footer_stats, select_row_groups
+    t = pa.table({"a": pa.array([None, 5, 6, 7], pa.int64())})
+    data = write_parquet(t)
+    stats = read_footer_stats(data)
+    assert stats[0].columns["a"].null_count == 1
+    # min=5: "a < 3" would prune on min/max alone, but the null row's
+    # fill value (0) passes the engine's raw-buffer comparison
+    kept, pruned = select_row_groups(stats, [("a", "<", 3)], 1)
+    assert (kept, pruned) == ([0], 0)

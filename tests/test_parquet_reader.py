@@ -393,3 +393,72 @@ def test_struct_with_mixed_members_decodes_whole(tmp_path):
     assert list(got.names) == ["s", "ok"]
     assert got["s"].to_pylist() == [{"x": 1, "v": [1, 2]}]
     assert got["ok"].to_pylist() == [5]
+
+
+# ---- row-group selection + typed-empty regression (streaming IO) ------------
+
+def test_row_groups_selection(tmp_path):
+    """row_groups= restricts the chunk sequence to the given groups, in
+    the given order, composing with columns= selective decode."""
+    n = 4000
+    t = pa.table({"a": pa.array(range(n), pa.int64()),
+                  "b": pa.array([i * 0.5 for i in range(n)], pa.float64())})
+    path = _write(tmp_path, t, row_group_size=1000, compression="NONE")
+    got = read_parquet(path, columns=["a"], row_groups=[1, 3])
+    assert list(got.names) == ["a"]
+    assert got["a"].to_pylist() == list(range(1000, 2000)) + \
+        list(range(3000, 4000))
+    with ParquetChunkedReader(path, row_groups=[2]) as r:
+        assert r.num_row_groups == 4          # file total, not selection
+        assert r.has_next()
+        chunk = r.read_chunk()
+        assert chunk["a"].to_pylist() == list(range(2000, 3000))
+        assert not r.has_next()
+    with pytest.raises(IndexError):
+        ParquetChunkedReader(path, row_groups=[4])
+
+
+def test_read_all_zero_row_groups_typed_empty(tmp_path):
+    """read_all() over an empty selection returns the TYPED empty table —
+    the _empty_columns path — including under columns= selection."""
+    n = 100
+    t = pa.table({"a": pa.array(range(n), pa.int64()),
+                  "s": pa.array([f"v{i}" for i in range(n)], pa.string()),
+                  "f": pa.array([i * 1.5 for i in range(n)], pa.float64())})
+    path = _write(tmp_path, t, compression="NONE")
+    from spark_rapids_tpu import dtypes
+    got = read_parquet(path, row_groups=[])
+    assert got.num_rows == 0
+    assert list(got.names) == ["a", "s", "f"]
+    assert got["a"].dtype == dtypes.INT64
+    assert got["s"].dtype == dtypes.STRING
+    assert got["f"].dtype == dtypes.FLOAT64
+    # with columns= selection: the typed empty respects the selection
+    got = read_parquet(path, columns=["f", "a"], row_groups=[])
+    assert got.num_rows == 0
+    assert list(got.names) == ["f", "a"]
+    assert got["f"].dtype == dtypes.FLOAT64
+    assert got["a"].dtype == dtypes.INT64
+
+
+def test_read_all_zero_row_group_file():
+    """A parquet file with ZERO row groups (pyarrow: empty table) decodes
+    to the typed empty table, with and without columns=."""
+    import io as _io
+    from spark_rapids_tpu import dtypes
+    t = pa.table({"a": pa.array([], pa.int64()),
+                  "s": pa.array([], pa.string())})
+    sink = _io.BytesIO()
+    pq.write_table(t, sink, compression="NONE")
+    data = sink.getvalue()
+    md = pq.read_metadata(_io.BytesIO(data))
+    with ParquetChunkedReader(data) as r:
+        assert r.num_row_groups == md.num_row_groups
+        got = r.read_all()
+    assert got.num_rows == 0
+    assert list(got.names) == ["a", "s"]
+    assert got["a"].dtype == dtypes.INT64
+    got = read_parquet(data, columns=["s"])
+    assert got.num_rows == 0
+    assert list(got.names) == ["s"]
+    assert got["s"].dtype == dtypes.STRING
