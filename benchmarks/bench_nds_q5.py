@@ -219,11 +219,24 @@ def main(argv=None):
 
     # plan tier, optimizer off AND on: parity asserted, rows/bytes deltas
     # on the JSONL rows (docs/optimizer.md)
-    from benchmarks.nds_plans import q5_inputs, q5_plan, run_plan_variants
+    from benchmarks.nds_plans import (dist_mesh, q5_inputs, q5_plan,
+                                      run_plan_distributed,
+                                      run_plan_variants)
     run_plan_variants("nds_q5_pipeline_plan", {"num_rows": n_total},
                       q5_plan(), q5_inputs(tabs, dates),
                       n_rows=n_total, iters=args.iters,
                       caps=dict(key_cap=2048))
+
+    # distributed tier (docs/distributed.md): the same plan SPMD over a
+    # simulated mesh, parity-gated against the single-device eager run
+    mesh = dist_mesh()
+    if mesh is None:
+        print("# nds_q5_pipeline_dist skipped: needs >=4 devices "
+              "(set XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    else:
+        run_plan_distributed("nds_q5_pipeline_dist", {"num_rows": n_total},
+                             q5_plan(), q5_inputs(tabs, dates),
+                             n_rows=n_total, iters=args.iters, mesh=mesh)
 
 
 if __name__ == "__main__":

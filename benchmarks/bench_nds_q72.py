@@ -206,13 +206,25 @@ def main(argv=None):
 
     # plan tier, optimizer off AND on: parity asserted, rows/bytes deltas
     # on the JSONL rows (docs/optimizer.md)
-    from benchmarks.nds_plans import (q72_inputs, q72_plan,
+    from benchmarks.nds_plans import (dist_mesh, q72_inputs, q72_plan,
+                                      run_plan_distributed,
                                       run_plan_variants)
     run_plan_variants("nds_q72_pipeline_plan", {"num_sales": n},
                       q72_plan(), q72_inputs(*tabs),
                       n_rows=n, iters=args.iters,
                       caps=dict(row_cap=caps["row_cap"],
                                 key_cap=caps["key_cap"]))
+
+    # distributed tier (docs/distributed.md): the same plan SPMD over a
+    # simulated mesh, parity-gated against the single-device eager run
+    mesh = dist_mesh()
+    if mesh is None:
+        print("# nds_q72_pipeline_dist skipped: needs >=4 devices "
+              "(set XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    else:
+        run_plan_distributed("nds_q72_pipeline_dist", {"num_sales": n},
+                             q72_plan(), q72_inputs(*tabs),
+                             n_rows=n, iters=args.iters, mesh=mesh)
 
 
 if __name__ == "__main__":

@@ -116,13 +116,25 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
                 io_row_groups_pruned: int = None,
                 io_bytes_skipped: int = None,
                 io_overlap_ms: float = None,
+                mesh_axis: str = None,
+                exchange_bytes: int = None,
                 **extra) -> Dict:
     """Build + print one bench JSONL record.
 
     Every record carries `backend` (jax.default_backend() at emit time):
     the bench trajectory has silently compared CPU-fallback runs against
     device runs before (ROADMAP cross-cutting note) — a headline number
-    without its backend is not comparable to anything.
+    without its backend is not comparable to anything. `n_devices`
+    (visible device count at emit time) is stamped the same way: a
+    distributed-tier number measured over an N-way mesh is not comparable
+    to a single-chip row, and the mesh width must never be inferred from
+    the bench name (docs/distributed.md).
+
+    Optional distributed fields (the `*_dist` plan variants and the
+    nightly distributed-parity stage record these): `mesh_axis` (the mesh
+    axis name the plan was sharded over) and `exchange_bytes` (total ICI
+    buffer bytes moved by the plan's exchanges, summed from the per-op
+    metrics).
 
     Optional robustness fields (the chaos-soak stage records these, see
     benchmarks/chaos_soak.py / docs/robustness.md): `retries` (fault
@@ -143,9 +155,14 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
     execution — the prefetch pipeline's measured win)."""
     rec = {"bench": bench, "axes": axes, "ms": round(ms, 3),
            "rows_per_s": round(n_rows / (ms * 1e-3)),
-           "backend": jax.default_backend()}
+           "backend": jax.default_backend(),
+           "n_devices": len(jax.devices())}
     if impl is not None:
         rec["impl"] = impl
+    if mesh_axis is not None:
+        rec["mesh_axis"] = mesh_axis
+    if exchange_bytes is not None:
+        rec["exchange_bytes"] = exchange_bytes
     if retries is not None:
         rec["retries"] = retries
     if faults_injected is not None:

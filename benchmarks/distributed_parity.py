@@ -1,0 +1,90 @@
+"""Nightly distributed-parity stage (ci/nightly.sh, docs/distributed.md).
+
+Runs NDS q5 and q72 through the full-plan SPMD distributed tier on a
+>=4-device simulated CPU mesh (benchmarks/nds_plans.run_plan_distributed —
+the same helper the bench_nds_q*.py `*_dist` configs use), asserting:
+
+- EXACT result parity per query against the single-device eager tier
+  (scan -> join -> agg -> sort all on the mesh, one gather at the sink);
+- the optimizer's exchange_planning selected at least one BROADCAST join
+  (est_rows-driven small build side: q72's dimension joins, q5's date
+  window) and at least one hash-SHUFFLE join (the large-large cs ⋈ inv),
+  both verified on the EXECUTED plan's Exchange children;
+- a single sink gather and nonzero exchange-bytes on the JSONL rows.
+
+Emits one JSONL row per query with `n_devices`/`mesh_axis`/
+`exchange_bytes` plus planned/observed exchange kinds and elision counts,
+so the BENCH history tracks the distributed trajectory across revisions.
+"""
+import sys
+
+sys.path.insert(0, ".")
+
+import os  # noqa: E402
+
+# the mesh needs simulated devices BEFORE jax initializes a backend
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = \
+        (flags + " --xla_force_host_platform_device_count=8").strip()
+
+from benchmarks.common import parse_args                     # noqa: E402
+from benchmarks.nds_plans import (dist_mesh, q5_inputs,      # noqa: E402
+                                  q5_plan, q72_inputs, q72_plan,
+                                  run_plan_distributed)
+
+N_DEVICES = 4
+
+
+def _join_exchange_kinds(plan):
+    """Exchange kinds feeding HashJoin nodes of the EXECUTED plan — the
+    selection facts the gate asserts (an aggregate's hash exchange must
+    not satisfy the shuffle-JOIN requirement)."""
+    from spark_rapids_tpu.plan import Exchange, HashJoin
+    kinds = set()
+    for node in plan.nodes:
+        if isinstance(node, HashJoin):
+            for child in node.children:
+                if isinstance(child, Exchange):
+                    kinds.add(child.how)
+    return kinds
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    n = max(int(100_000 * args.scale), 10_000)   # keep cs above the
+    #                                              broadcast threshold
+    iters = min(args.iters, 3)
+
+    from benchmarks.bench_nds_q5 import build_tables as bt5
+    from benchmarks.bench_nds_q72 import build_tables as bt72
+
+    mesh = dist_mesh(N_DEVICES)
+    assert mesh is not None, \
+        f"distributed parity needs >= {N_DEVICES} simulated devices"
+
+    cases = {
+        "q5": (q5_plan(), q5_inputs(*bt5(n, seed=3))),
+        "q72": (q72_plan(), q72_inputs(*bt72(n, seed=5))),
+    }
+    join_kinds = set()
+    for name, (plan, inputs) in cases.items():
+        n_rows = sum(t.num_rows for t in inputs.values())
+        rec, res = run_plan_distributed(
+            f"distributed_parity_{name}", {"num_rows": n_rows}, plan,
+            inputs, n_rows=n_rows, iters=iters, mesh=mesh)
+        assert rec["exchange_bytes"] > 0, \
+            f"{name}: no exchange bytes recorded"
+        assert rec["gathers"] == 1, \
+            f"{name}: expected a single sink gather, got {rec['gathers']}"
+        assert res.optimizer["exchanges"]["gather"] == 1, name
+        join_kinds |= _join_exchange_kinds(res.plan)
+    assert "broadcast" in join_kinds, \
+        "no broadcast join selected across q5/q72"
+    assert "hash" in join_kinds, \
+        "no shuffle join selected across q5/q72"
+    print("distributed parity OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -215,6 +215,61 @@ def run_plan_variants(bench: str, axes: dict, plan, inputs, *,
     return recs
 
 
+# ---- distributed (*_dist) variants ------------------------------------------
+
+def dist_mesh(n_devices: int = 4, axis: str = "data"):
+    """A small simulated-CPU mesh for the `*_dist` plan variants, or None
+    when the process doesn't have enough devices (benches print a skip
+    note instead of failing — the driver must set
+    XLA_FLAGS=--xla_force_host_platform_device_count before jax init)."""
+    import jax
+    from spark_rapids_tpu.parallel import make_mesh
+    if len(jax.devices()) < n_devices:
+        return None
+    return make_mesh(n_devices, axis=axis)
+
+
+def run_plan_distributed(bench: str, axes: dict, plan, inputs, *,
+                         n_rows: int, iters: int, mesh,
+                         mesh_axis: str = "data"):
+    """Time the full-plan SPMD distributed tier (docs/distributed.md)
+    against the single-device eager tier, asserting EXACT result parity,
+    and record the distribution facts on the JSONL row: `n_devices`/
+    `mesh_axis`/`exchange_bytes` plus the optimizer's exchange selection
+    (planned kinds, elisions) and the observed gather count. Shared by
+    the bench_nds_q5/q72 `*_dist` configs and ci/nightly.sh's
+    distributed-parity stage. Returns (record, PlanResult)."""
+    from spark_rapids_tpu.plan import PlanExecutor
+    from benchmarks.common import run_config
+
+    ref = PlanExecutor(mode="eager").execute(plan, inputs)
+    ex = PlanExecutor(mesh=mesh, mesh_axis=mesh_axis)
+    res = ex.execute(plan, inputs)          # correctness + metrics run
+    assert not res.degraded, f"{bench}: distributed run degraded to CPU"
+    assert res.table.to_pydict() == ref.table.to_pydict(), \
+        f"{bench}: distributed result differs from the single-device tier"
+    observed = {}
+    for m in res.metrics.values():
+        if m.exchange_how:
+            observed[m.exchange_how] = observed.get(m.exchange_how, 0) + 1
+    opt = res.optimizer or {}
+
+    def prun():
+        r = ex.execute(plan, inputs)
+        return [c.data for c in r.table.columns]
+
+    rec = run_config(
+        bench, dict(axes), prun, (), n_rows=n_rows, iters=iters,
+        jit=False, impl="plan_distributed", mesh_axis=mesh_axis,
+        exchange_bytes=sum(m.exchange_bytes for m in res.metrics.values()),
+        mesh_devices=int(mesh.shape[mesh_axis]),
+        exchanges_planned=opt.get("exchanges", {}),
+        exchanges_elided=opt.get("exchanges_elided", 0),
+        exchanges_observed=observed,
+        gathers=observed.get("gather", 0))
+    return rec, res
+
+
 # ---- input bindings ---------------------------------------------------------
 
 def q3_inputs(sales, dates, items):

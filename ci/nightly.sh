@@ -33,6 +33,14 @@ JAX_PLATFORMS=cpu python benchmarks/optimizer_parity.py --scale 0.1 --cpu
 # measurably fewer decoded bytes), and decode/execute overlap > 0 with the
 # prefetch pipeline enabled; emits io_* + backend JSONL fields
 JAX_PLATFORMS=cpu python benchmarks/streaming_scan.py --scale 0.5 --cpu
+# distributed parity (docs/distributed.md): NDS q5/q72 through the
+# full-plan SPMD tier on a 4-device simulated mesh — exact parity vs the
+# single-device eager tier, >=1 broadcast and >=1 shuffle join selected by
+# exchange_planning (checked on the executed plan), one sink gather, and
+# nonzero exchange-bytes; emits n_devices/mesh_axis/exchange_bytes JSONL
+# fields
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python benchmarks/distributed_parity.py --scale 0.2 --cpu
 ./ci/fuzz-test.sh
 ./ci/sanitizer.sh
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

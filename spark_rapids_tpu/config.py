@@ -24,6 +24,8 @@ equivalents, all read at use time (not import time) so tests can monkeypatch:
 | SPARK_RAPIDS_TPU_OPTIMIZER       | on   | rule-based plan optimizer (plan/optimizer.py): on/off |
 | SPARK_RAPIDS_TPU_IO_PREFETCH     | 2    | streaming-scan prefetch depth (chunks decoded ahead); 0 = decode inline |
 | SPARK_RAPIDS_TPU_IO_CHUNK_ROWS   | 0    | streaming-scan morsel row bound (0 = one chunk per row group) |
+| SPARK_RAPIDS_TPU_BROADCAST_ROWS  | 8192 | distributed tier: estimated build-side rows at or below which exchange_planning picks a broadcast join over a shuffle |
+| SPARK_RAPIDS_TPU_DIST_SLACK      | 2.0  | distributed tier: initial per-bucket slack factor for hash/range exchanges (grows geometrically on overflow) |
 
 The SPARK_RAPIDS_TPU_BREAKER_* numeric knobs are snapshotted when a
 `DeviceHealthMonitor` is constructed (one policy per monitor lifetime —
@@ -152,6 +154,24 @@ def io_chunk_rows() -> int:
     streams one chunk per row group. Returns 0 for "unbounded-by-rows";
     callers treat it as falsy."""
     return max(0, _int_env("SPARK_RAPIDS_TPU_IO_CHUNK_ROWS", 0))
+
+
+def broadcast_rows() -> int:
+    """Distributed tier (docs/distributed.md): the optimizer's
+    exchange_planning rule replicates a join's build side (broadcast join,
+    no shuffle of the probe side) when its estimated row count is at or
+    below this — the row-count analogue of Spark's
+    autoBroadcastJoinThreshold. Estimates come from bound tables or
+    `est_rows` scan hints."""
+    return _int_env("SPARK_RAPIDS_TPU_BROADCAST_ROWS", 8192)
+
+
+def dist_slack() -> float:
+    """Distributed tier: initial slack factor sizing the fixed-capacity
+    exchange buckets (capacity = rows/peer x slack). Skew past the slack
+    raises the overflow flag and the executor retries with geometrically
+    grown slack (SplitAndRetry contract, parallel/autoretry.py)."""
+    return _float_env("SPARK_RAPIDS_TPU_DIST_SLACK", 2.0)
 
 
 def groupby_kernel() -> str:

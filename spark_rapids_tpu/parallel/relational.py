@@ -189,7 +189,7 @@ def distributed_groupby(mesh: Mesh, keys: jnp.ndarray, vals: jnp.ndarray,
 def distributed_groupby_multi(mesh: Mesh, keys: Sequence[jnp.ndarray],
                               vals: Sequence[jnp.ndarray],
                               aggs: Sequence[Tuple[int, str]], key_cap: int,
-                              axis: str = "data", hash_fn=None):
+                              axis: str = "data", hash_fn=None, alive=None):
     """Multi-key, multi-value groupby over the mesh — same two-stage shape
     as distributed_groupby but grouping on a tuple of int64 key columns and
     aggregating [(value index, op)] pairs.
@@ -198,6 +198,9 @@ def distributed_groupby_multi(mesh: Mesh, keys: Sequence[jnp.ndarray],
     typed-key path passes keys.spark_partition_hash so string/decimal keys
     place exactly like GpuHashPartitioning); default is the chained murmur
     over raw int64 words.
+
+    `alive` (optional sharded (n,) bool) excludes dead rows — the plan
+    tier's padded sharded relations aggregate live rows only.
 
     Returns per-shard padded ([key arrays], [agg arrays], valid, overflow).
     """
@@ -225,12 +228,14 @@ def distributed_groupby_multi(mesh: Mesh, keys: Sequence[jnp.ndarray],
                 for p, (_, a) in zip(partials, aggs)]
 
     nk = len(keys)
+    nv = len(vals)
+    has_alive = alive is not None
 
     def local(*arrs):
-        ks, vs = list(arrs[:nk]), list(arrs[nk:])
-        alive = jnp.ones(ks[0].shape, bool)
+        ks, vs = list(arrs[:nk]), list(arrs[nk:nk + nv])
+        live = arrs[-1] if has_alive else jnp.ones(ks[0].shape, bool)
         gks, partials, gvalid, n_real = _merge_groups(
-            ks, alive, partial_cols(ks[0], vs), key_cap)
+            ks, live, partial_cols(ks[0], vs), key_cap)
         overflow = n_real > key_cap
 
         part = partition_ids((hash_fn or _spark_murmur_i64)(gks), n_peers)
@@ -247,16 +252,18 @@ def distributed_groupby_multi(mesh: Mesh, keys: Sequence[jnp.ndarray],
         return (tuple(fks), tuple(fouts), fvalid, overflow.reshape(1))
 
     spec = P(axis)
-    fn = shard_map(local, mesh=mesh, in_specs=(spec,) * (nk + len(vals)),
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(spec,) * (nk + nv + int(has_alive)),
                    out_specs=(tuple(spec for _ in keys),
                               tuple(spec for _ in aggs), spec, spec))
-    return fn(*keys, *vals)
+    args = list(keys) + list(vals) + ([alive] if has_alive else [])
+    return fn(*args)
 
 
 def distributed_groupby_keyed(mesh: Mesh, key_words: Sequence[jnp.ndarray],
                               key_specs, vals: Sequence[jnp.ndarray],
                               aggs: Sequence[Tuple[int, str]], key_cap: int,
-                              axis: str = "data"):
+                              axis: str = "data", alive=None):
     """Typed-key groupby: key columns of ANY supported dtype (string,
     decimal128, float, nullable int — see parallel/keys.py) encoded as word
     lists ride the same SPMD program as the int64 path; partition placement
@@ -266,7 +273,170 @@ def distributed_groupby_keyed(mesh: Mesh, key_words: Sequence[jnp.ndarray],
     from .keys import spark_partition_hash
     return distributed_groupby_multi(
         mesh, key_words, vals, aggs, key_cap, axis,
-        hash_fn=lambda ws: spark_partition_hash(ws, key_specs))
+        hash_fn=lambda ws: spark_partition_hash(ws, key_specs), alive=alive)
+
+
+def distributed_local_groupby(mesh: Mesh, key_words: Sequence[jnp.ndarray],
+                              vals: Sequence[jnp.ndarray],
+                              aggs: Sequence[Tuple[int, str]], key_cap: int,
+                              axis: str = "data", alive=None):
+    """Shard-local groupby merge for PRE-PARTITIONED inputs: every row of a
+    group is already co-located (the input sits below an ELIDED exchange —
+    e.g. a shuffle join on a subset of the group keys already placed equal
+    keys on one shard), so the two-stage shape collapses to ONE
+    `_merge_groups` per shard with no collective at all. Same return
+    contract as distributed_groupby_multi; `overflow` means a shard held
+    more than key_cap distinct live groups."""
+    for _, a in aggs:
+        if a not in _AGGS:
+            raise ValueError(f"unsupported distributed agg {a!r}")
+    key_words = list(key_words)
+    vals = list(vals)
+    nk, nv = len(key_words), len(vals)
+    aggs = tuple((int(i), a) for i, a in aggs)
+    has_alive = alive is not None
+
+    def local(*arrs):
+        ks, vs = list(arrs[:nk]), list(arrs[nk:nk + nv])
+        live = arrs[-1] if has_alive else jnp.ones(ks[0].shape, bool)
+        ones = jnp.ones(ks[0].shape, jnp.int64)
+        cols = [(ones if a == "count" else vs[i],
+                 "sum" if a in ("sum", "count") else a) for i, a in aggs]
+        gks, outs, gvalid, n_real = _merge_groups(ks, live, cols, key_cap)
+        overflow = n_real > key_cap
+        return (tuple(gks), tuple(outs), gvalid, overflow.reshape(1))
+
+    spec = P(axis)
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(spec,) * (nk + nv + int(has_alive)),
+                   out_specs=(tuple(spec for _ in key_words),
+                              tuple(spec for _ in aggs), spec, spec))
+    args = key_words + vals + ([alive] if has_alive else [])
+    return fn(*args)
+
+
+def distributed_repartition_keyed(mesh: Mesh,
+                                  key_words: Sequence[jnp.ndarray],
+                                  key_specs, vals: Sequence[jnp.ndarray],
+                                  slack: float = 2.0, axis: str = "data",
+                                  alive=None):
+    """Standalone hash-partition exchange of one relation — the physical
+    form of an `Exchange(hash)` plan node: every row moves to the shard
+    given by the Spark-exact hash of its key words (pmod n_peers), so a
+    downstream co-located operator (colocated join, elided-exchange
+    groupby) can run with no further collective. `alive` marks live rows
+    of a padded sharded relation; dead rows are dropped by the bucketing.
+
+    Returns ([key words], [vals], valid, overflow); overflow means a
+    bucket spilled its slack-sized capacity — retry with bigger slack
+    (SplitAndRetry contract)."""
+    from .keys import spark_partition_hash
+    n_peers = mesh.shape[axis]
+    hash_fn = lambda ws: spark_partition_hash(ws, key_specs)  # noqa: E731
+    key_words = list(key_words)
+    vals = list(vals)
+    nk, nv = len(key_words), len(vals)
+    has_alive = alive is not None
+
+    def local(*arrs):
+        ws, vs = list(arrs[:nk]), list(arrs[nk:nk + nv])
+        live = arrs[-1] if has_alive else None
+        Ws, Vs, recv_alive, spilled = _hash_exchange(
+            axis, n_peers, slack, ws, vs, hash_fn, alive=live)
+        return (tuple(Ws), tuple(Vs), recv_alive, spilled.reshape(1))
+
+    spec = P(axis)
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(spec,) * (nk + nv + int(has_alive)),
+                   out_specs=(tuple(spec for _ in key_words),
+                              tuple(spec for _ in vals), spec, spec))
+    args = key_words + vals + ([alive] if has_alive else [])
+    return fn(*args)
+
+
+def distributed_colocated_join_keyed(mesh: Mesh,
+                                     l_words: Sequence[jnp.ndarray],
+                                     lvals: Sequence[jnp.ndarray],
+                                     r_words: Sequence[jnp.ndarray],
+                                     rvals: Sequence[jnp.ndarray],
+                                     key_specs, row_cap: int = 0,
+                                     axis: str = "data", how: str = "inner",
+                                     lalive=None, ralive=None,
+                                     r_replicated: bool = False):
+    """Equi-join of two ALREADY-ALIGNED sides with no exchange: both sides
+    are either hash-partitioned by the positionally-matching key tuples
+    (the explicit `Exchange(hash)` ran upstream, so matching rows are
+    co-located), or the right side is REPLICATED (`r_replicated=True`: the
+    `Exchange(broadcast)` replicated the small build side onto every
+    shard, the probe side never moves). Each shard then joins locally —
+    the plan tier's counterpart of Spark executing a join above its
+    exchanges.
+
+    `how`: inner (padded row_cap output), left_semi / left_anti (output
+    stays left-shaped, no row_cap). `lalive`/`ralive` mark live rows of
+    padded sharded relations; NULL keys never match (Spark equi-join
+    semantics).
+
+    Returns: inner -> ([l key words], [lvals], [rvals], valid, overflow);
+    semi/anti -> ([l key words], [lvals], keep, overflow)."""
+    from .keys import keys_null_mask
+    l_words, lvals = list(l_words), list(lvals)
+    r_words, rvals = list(r_words), list(rvals)
+    _check_word_counts(l_words, r_words)
+    nw, nlv, nrv = len(l_words), len(lvals), len(rvals)
+    has_lal, has_ral = lalive is not None, ralive is not None
+    semi_anti = how in ("left_semi", "left_anti")
+    if how not in ("inner", "left_semi", "left_anti"):
+        raise ValueError(f"unsupported colocated join type {how!r}")
+
+    def local(*arrs):
+        i = 0
+        lw = list(arrs[i:i + nw]); i += nw
+        lv = list(arrs[i:i + nlv]); i += nlv
+        rw = list(arrs[i:i + nw]); i += nw
+        rv = list(arrs[i:i + nrv]); i += nrv
+        Lal = arrs[i] if has_lal else jnp.ones(lw[0].shape, bool)
+        i += int(has_lal)
+        Ral = arrs[i] if has_ral else jnp.ones(rw[0].shape, bool)
+        lmatch = Lal & ~keys_null_mask(lw, key_specs)
+        rmatch = Ral & ~keys_null_mask(rw, key_specs)
+        if semi_anti:
+            nl = lw[0].shape[0]
+            operands = tuple(jnp.concatenate([a, b])
+                             for a, b in zip(lw, rw))
+            counts, _, _ = join_spans(operands, lmatch, rmatch, nl=nl,
+                                      need_rorder=False)
+            hit = counts > 0
+            keep = Lal & (hit if how == "left_semi" else ~hit)
+            out_lw = [jnp.where(keep, w, jnp.asarray(0, w.dtype))
+                      for w in lw]
+            out_lv = [jnp.where(keep, v, jnp.asarray(0, v.dtype))
+                      for v in lv]
+            return (tuple(out_lw), tuple(out_lv), keep,
+                    jnp.zeros((1,), bool))
+        out_lw, out_lv, out_rv, _, live, ovf = _local_join_tail(
+            lw, lv, Lal, rw, rv, Ral, row_cap, outer=False,
+            lmatch=lmatch, rmatch=rmatch)
+        return (tuple(out_lw), tuple(out_lv), tuple(out_rv), live,
+                ovf.reshape(1))
+
+    spec = P(axis)
+    rspec = P() if r_replicated else spec
+    in_specs = ((spec,) * (nw + nlv) + (rspec,) * (nw + nrv)
+                + (spec,) * int(has_lal) + (rspec,) * int(has_ral))
+    if semi_anti:
+        out_specs = (tuple(spec for _ in l_words),
+                     tuple(spec for _ in lvals), spec, spec)
+    else:
+        out_specs = (tuple(spec for _ in l_words),
+                     tuple(spec for _ in lvals),
+                     tuple(spec for _ in rvals), spec, spec)
+    fn = shard_map(local, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs)
+    args = (l_words + lvals + r_words + rvals
+            + ([lalive] if has_lal else [])
+            + ([ralive] if has_ral else []))
+    return fn(*args)
 
 
 def distributed_sort(mesh: Mesh, keys: jnp.ndarray, vals: jnp.ndarray,
@@ -292,8 +462,8 @@ def distributed_sort(mesh: Mesh, keys: jnp.ndarray, vals: jnp.ndarray,
 
 
 def distributed_sort_keyed(mesh: Mesh, key_words: Sequence[jnp.ndarray],
-                           key_specs, vals: jnp.ndarray, slack: float = 2.0,
-                           axis: str = "data"):
+                           key_specs, vals, slack: float = 2.0,
+                           axis: str = "data", alive=None):
     """Global sort over typed keys (word lists from keys.encode_key_columns,
     so string/decimal128/float/nullable keys all sort) — sample-sort as one
     jitted SPMD program, the multi-word generalization of distributed_sort.
@@ -305,24 +475,42 @@ def distributed_sort_keyed(mesh: Mesh, key_words: Sequence[jnp.ndarray],
     for the caller's later decode; the sort itself needs only the
     order-preserving words (pass None when sorting raw arrays).
 
-    Returns per-shard ([key words], vals, valid, overflow); shard 0 ends
-    with the smallest keys. overflow means a shard received more than its
-    slack-sized capacity (skewed keys) — retry with bigger slack."""
+    `vals` may be one payload array or a list (a whole table side rides the
+    sort); `alive` (optional sharded (n,) bool) marks live rows of a padded
+    sharded relation — dead rows sink out of the sampled runs, route to the
+    out-of-range partition, and never reach any shard's output.
+
+    Returns per-shard ([key words], vals (matching the input shape), valid,
+    overflow); shard 0 ends with the smallest keys. overflow means a shard
+    received more than its slack-sized capacity (skewed keys) — retry with
+    bigger slack."""
     del key_specs  # symmetry/decode-side only
     n_peers = mesh.shape[axis]
     key_words = list(key_words)
     nw = len(key_words)
+    multi_vals = isinstance(vals, (list, tuple))
+    val_list = list(vals) if multi_vals else [vals]
+    nv = len(val_list)
+    has_alive = alive is not None
 
     def local(*arrs):
-        ws, v = list(arrs[:nw]), arrs[nw]
+        ws, vs = list(arrs[:nw]), list(arrs[nw:nw + nv])
+        live = arrs[-1] if has_alive else jnp.ones(ws[0].shape, bool)
         nloc = ws[0].shape[0]
         cap = max(1, math.ceil(nloc / n_peers * slack))
         iota = jnp.arange(nloc, dtype=jnp.int32)
-        out = jax.lax.sort([*ws, iota], num_keys=nw, is_stable=True)
+        # dead rows take the sentinel and sink to the end of the local run,
+        # so the live prefix is exactly the shard's real rows
+        ks = [jnp.where(live, w, _DEAD_KEY) for w in ws]
+        out = jax.lax.sort([*ks, iota], num_keys=nw, is_stable=True)
         sws, order = list(out[:-1]), out[-1]
-        sv = jnp.take(v, order, axis=0)
-        # P-1 evenly spaced local sample TUPLES from the sorted run
-        pos = (jnp.arange(1, n_peers, dtype=jnp.int32) * nloc) // n_peers
+        svs = [jnp.take(v, order, axis=0) for v in vs]
+        salive = jnp.take(live, order, axis=0)
+        nlive = jnp.sum(salive.astype(jnp.int32))
+        # P-1 evenly spaced local sample TUPLES from the LIVE prefix of the
+        # sorted run (sampling over nloc would pull dead-sentinel tuples
+        # into the splitter pool and skew every splitter high)
+        pos = (jnp.arange(1, n_peers, dtype=jnp.int32) * nlive) // n_peers
         pools = []
         for w in sws:
             samples = jnp.take(w, pos, axis=0, mode="clip")
@@ -343,23 +531,29 @@ def distributed_sort_keyed(mesh: Mesh, key_words: Sequence[jnp.ndarray],
         # strict splitter<row mirrors distributed_sort's `row > splitter`:
         # rows equal to a splitter stay in the lower bucket
         part = jnp.sum(lt, axis=1).astype(jnp.int32)
+        part = jnp.where(salive, part, jnp.int32(n_peers))  # drop dead rows
         recv, ralive_, spilled = _bucket_exchange(
             axis, n_peers, cap, part,
-            [(w, _DEAD_KEY) for w in sws] + [(sv, 0)])
+            [(w, _DEAD_KEY) for w in sws] + [(sv, 0) for sv in svs])
         spilled = jax.lax.all_gather(spilled.reshape(1), axis).any()
-        rws, rv = recv[:nw], recv[nw]
+        rws, rvs = recv[:nw], recv[nw:]
         # final local sort; dead slots carry the sentinel and sink last
         dead_flag = jnp.where(ralive_, jnp.int32(0), jnp.int32(1))
         keyed = [jnp.where(ralive_, w, _DEAD_KEY) for w in rws]
-        out2 = jax.lax.sort([*keyed, dead_flag, rv], num_keys=nw + 1,
+        out2 = jax.lax.sort([*keyed, dead_flag, *rvs], num_keys=nw + 1,
                             is_stable=True)
-        return (tuple(out2[:nw]), out2[-1], out2[nw] == 0,
-                spilled.reshape(1))
+        out_vs = tuple(out2[nw + 1:])
+        return (tuple(out2[:nw]), out_vs if multi_vals else out_vs[0],
+                out2[nw] == 0, spilled.reshape(1))
 
     spec = P(axis)
-    fn = shard_map(local, mesh=mesh, in_specs=(spec,) * (nw + 1),
-                   out_specs=(tuple(spec for _ in key_words),) + (spec,) * 3)
-    return fn(*key_words, vals)
+    val_out_spec = tuple(spec for _ in val_list) if multi_vals else spec
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(spec,) * (nw + nv + int(has_alive)),
+                   out_specs=(tuple(spec for _ in key_words), val_out_spec,
+                              spec, spec))
+    args = key_words + val_list + ([alive] if has_alive else [])
+    return fn(*args)
 
 
 def _as_list(x):
@@ -397,26 +591,36 @@ def _local_join_tail(lk, lv, lalive, rk, rv, ralive, row_cap: int,
                               eff=eff)
     live = jnp.arange(row_cap, dtype=jnp.int32) < total
     rmatched = rsel >= 0 if outer else jnp.ones((row_cap,), bool)
-    out_lks = [jnp.where(live, jnp.take(k, lsel, axis=0), 0) for k in lks]
-    out_lvs = [jnp.where(live, jnp.take(v, lsel, axis=0), 0) for v in lvs]
+    # dead-slot zeros keep each payload's dtype (a weak-typed python 0
+    # would promote bool validity payloads to int)
+    out_lks = [jnp.where(live, jnp.take(k, lsel, axis=0),
+                         jnp.asarray(0, k.dtype)) for k in lks]
+    out_lvs = [jnp.where(live, jnp.take(v, lsel, axis=0),
+                         jnp.asarray(0, v.dtype)) for v in lvs]
     safe_rsel = jnp.maximum(rsel, 0)
-    out_rvs = [jnp.where(live & rmatched, jnp.take(v, safe_rsel, axis=0), 0)
+    out_rvs = [jnp.where(live & rmatched, jnp.take(v, safe_rsel, axis=0),
+                         jnp.asarray(0, v.dtype))
                for v in rvs]
     return out_lks, out_lvs, out_rvs, rmatched & live, live, total > row_cap
 
 
 def _hash_exchange(axis: str, n_peers: int, slack: float,
-                   keys, vals, hash_fn=None):
+                   keys, vals, hash_fn=None, alive=None):
     """Hash-partition by Spark murmur pmod and all-to-all one table side
     (the shared shuffle wiring of every distributed join). `keys` may be a
     single int64 array or a word list (typed keys); `vals` may be None
     (key-only sides, e.g. semi/anti build side), one array, or a list.
-    Returns (key outs, val outs, alive, spilled)."""
+    `alive` (optional (n,) bool) marks live rows: dead rows route to the
+    out-of-range partition id `n_peers` and are silently dropped by the
+    bucketing — the padded-relation contract of the plan tier's sharded
+    relations. Returns (key outs, val outs, alive, spilled)."""
     key_list = _as_list(keys)
     val_list = [] if vals is None else _as_list(vals)
     nloc = key_list[0].shape[0]
     cap = max(1, math.ceil(nloc / n_peers * slack))
     part = partition_ids((hash_fn or _spark_murmur_i64)(key_list), n_peers)
+    if alive is not None:
+        part = jnp.where(alive, part, jnp.int32(n_peers))
     payloads = [(k, _DEAD_KEY) for k in key_list] + [(v, 0) for v in val_list]
     outs, alive, spilled = _bucket_exchange(axis, n_peers, cap, part, payloads)
     # a spill anywhere means some shard RECEIVED an incomplete side: agree on

@@ -24,6 +24,11 @@ concerns live in ONE executor:
 - `executor`: walks the DAG composing the public `ops` kernels (eager tier)
   or traces the whole plan into ONE capped XLA program (jit tier) with
   geometric cap escalation via `parallel.autoretry` at plan granularity;
+  with a device mesh the eager walk runs full-plan SPMD over sharded
+  relations (`distributed`, docs/distributed.md) — shuffle/broadcast
+  joins, fused two-phase aggregates, sample-sort — crossing the ICI only
+  at the `Exchange` boundaries the optimizer plans, and gathering to one
+  device only at the sink;
   admission (`runtime.admission`), `faultinj` interception and
   `utils.tracing` ranges apply per operator. Device failures resolve
   through the `runtime.health` degradation policy — backoff-paced retries

@@ -22,11 +22,21 @@ disables it. `PlanResult.optimizer` reports what fired.
   escalated caps are remembered for the rest of the job AND structurally
   identical plans built independently share compiled programs
   (`optimizer.plan_fingerprint`).
-- distributed (eager tier only — the constructor rejects a mesh with
-  mode="capped"): when a device `mesh` is given, a `HashAggregate` sitting
-  on an `Exchange` runs on the `parallel.relational` tier (partial agg →
-  all-to-all → final agg) with the same geometric escalation via
-  `distributed_groupby`'s overflow contract.
+- distributed (eager tier only — execute() rejects a mesh with
+  mode="capped" when the plan contains a distributed-lowerable operator):
+  when a device `mesh` is given, the whole plan runs as SPMD over the mesh
+  (plan/distributed.py, docs/distributed.md): Scans shard row-wise,
+  Filter/Project stay elementwise-sharded, joins run shuffle
+  (hash-exchange both sides) or broadcast (replicate the small build
+  side, chosen by the optimizer's `exchange_planning` rule from row
+  estimates), aggregates fuse the two-phase partial→all-to-all→final
+  program behind their `Exchange` (elided entirely when the input is
+  already partitioned by a subset of the group keys), Sort/TopK
+  sample-sort to global order, and the result gathers to one device only
+  at the sink — or at the first operator with no distributed form, the
+  same graceful-boundary pattern as the streaming tier's concat. All
+  static capacities escalate via `parallel.autoretry` and memoize per
+  plan fingerprint.
 
 Admission (`runtime.admission`) applies per operator automatically: the
 executor calls the public `ops` surface through module attribute lookup, so
@@ -105,10 +115,14 @@ def _cpu_device():
 def _table_to_cpu(t: Table, dev) -> Table:
     """Salvage a table onto the CPU backend through host memory (the
     degraded tier's handoff for results computed before the breaker
-    tripped). Streaming source bindings pass through untouched — they are
-    host-side handles the CPU tier re-reads directly."""
+    tripped). Distributed-tier sharded relations gather + compact first
+    (their live rows ARE the relation). Streaming source bindings pass
+    through untouched — they are host-side handles the CPU tier re-reads
+    directly."""
     import dataclasses
 
+    if hasattr(t, "to_local_table"):          # plan.distributed.ShardedRel
+        t = t.to_local_table()
     if not isinstance(t, Table):
         return t
 
@@ -373,10 +387,9 @@ class PlanExecutor:
                  optimize: Optional[bool] = None):
         if mode not in ("eager", "capped"):
             raise ValueError(f"unknown executor mode {mode!r}")
-        if mesh is not None and mode != "eager":
-            raise ValueError(
-                "distributed lowering (mesh=) exists only in the eager tier "
-                "for now; a capped executor would silently ignore the mesh")
+        # mesh + capped is checked PER PLAN in execute(): only a plan that
+        # actually contains a distributed-lowerable operator is rejected
+        # (naming it), so trivial row-wise plans still run capped
         from .. import config
         from ..runtime.health import DeviceHealthMonitor
         self.mode = mode
@@ -407,10 +420,29 @@ class PlanExecutor:
         # independently, starts from the grown caps instead of re-paying
         # the whole overflow ladder
         self._caps_memo: Dict[str, Dict[str, int]] = _LruDict(256)
+        # distributed-tier capacity memo: (fingerprint, node index) ->
+        # final escalated caps, same contract as _caps_memo
+        self._dist_caps_memo: Dict[Tuple, Dict] = _LruDict(256)
+
+    def _check_capped_mesh(self, plan: Plan) -> None:
+        """mode="capped" with a mesh: reject ONLY plans that contain a
+        distributed-lowerable operator (the capped tier would silently run
+        it on one chip), naming the offending node."""
+        if self.mesh is None or self.mode == "eager":
+            return
+        for n in plan.nodes:
+            if isinstance(n, (Exchange, HashJoin, HashAggregate, Sort,
+                              TopK, Union)):
+                raise PlanValidationError(
+                    f"{n.label}: distributed lowering (mesh=) exists only "
+                    "in the eager tier; a capped executor would silently "
+                    f"run this {n.kind} on one chip — drop the mesh or use "
+                    "mode=\"eager\"")
 
     # ---- entry point ------------------------------------------------------
     def execute(self, plan: Plan,
                 inputs: Optional[Dict[str, Table]] = None) -> PlanResult:
+        self._check_capped_mesh(plan)
         # a Scan carrying its own parquet binding needs no inputs= entry;
         # an explicit entry (Table or source) for the same name wins
         inputs = dict(inputs or {})
@@ -454,14 +486,24 @@ class PlanExecutor:
         # only for these, so the set belongs in the cache key too
         streaming = frozenset(n for n, t in inputs.items()
                               if not isinstance(t, Table))
+        # the exchange_planning rule fires only for a meshed eager
+        # executor, and its placements depend on the mesh width AND the
+        # broadcast threshold (read at use time per config.py's
+        # monkeypatch contract) — all of it belongs in the cache key
+        from .. import config
+        mesh_peers = (self.mesh.shape[self.mesh_axis]
+                      if self.mesh is not None and self.mode == "eager"
+                      else None)
+        bc_rows = config.broadcast_rows() if mesh_peers else None
         key = (plan.root, tuple(sorted(bound.items())),
                tuple(sorted((n, t.num_rows) for n, t in inputs.items())),
-               floats, streaming)
+               floats, streaming, mesh_peers, bc_rows)
         hit = self._opt_cache.get(key)
         if hit is None:
             opt, report = run_optimizer(
                 plan, bound, {n: t.num_rows for n, t in inputs.items()},
-                float_inputs=floats, streaming_sources=streaming)
+                float_inputs=floats, streaming_sources=streaming,
+                mesh_peers=mesh_peers)
             hit = (opt, opt.resolve_schemas(bound), report)
             self._opt_cache[key] = hit
         return hit
@@ -567,10 +609,19 @@ class PlanExecutor:
             return self._execute_degraded(plan, inputs, schemas, results,
                                           metrics, start=0, t_plan0=t_plan0,
                                           mode="eager")
+        # full-plan SPMD tier (plan/distributed.py): with a mesh, nodes
+        # execute over sharded relations and gather only at the sink (or
+        # the first operator with no distributed form). Streaming prefixes
+        # are a single-chip pipeline shape — the distributed tier
+        # materializes source-bound scans through one pruned read instead.
+        dist = None
+        if self.mesh is not None:
+            from .distributed import DistContext
+            dist = DistContext(self, plan, inputs)
         # streamable prefixes over source-bound scans run morsel-at-a-time
         # (decode chunk N+1 on host while chunk N executes); their interior
         # nodes never materialize a whole relation, only the chain tail does
-        chains = self._stream_chains(plan, inputs)
+        chains = {} if dist is not None else self._stream_chains(plan, inputs)
         chain_interior = {id(n) for ch in chains.values() for n in ch[:-1]}
         node_index = {id(n): i for i, n in enumerate(plan.nodes)}
         try:
@@ -605,8 +656,13 @@ class PlanExecutor:
                     try:
                         with tracing.range_ctx(f"plan.{node.label}"):
                             self._faultinj_point(node)
-                            out = self._exec_eager_node(node, child_tables,
-                                                        inputs, schemas, m)
+                            if dist is not None:
+                                out = dist.exec_node(node, child_tables,
+                                                     inputs, schemas, m,
+                                                     metrics)
+                            else:
+                                out = self._exec_eager_node(
+                                    node, child_tables, inputs, schemas, m)
                         break
                     except _fault_surface() as err:
                         if self._handle_fault(err, node.label, attempt, m):
@@ -629,7 +685,8 @@ class PlanExecutor:
                 m.wall_ms = (time.perf_counter() - t0) * 1e3 - m.backoff_ms
                 m.rows_in = sum(t.num_rows for t in child_tables)
                 m.rows_out = out.num_rows
-                m.bytes_out = operand_nbytes(out)
+                m.bytes_out = operand_nbytes(
+                    out if isinstance(out, Table) else out.table)
                 metrics[node.label] = m
                 results[id(node)] = out
         except BaseException as err:
@@ -643,8 +700,14 @@ class PlanExecutor:
                 except Exception:
                     pass
             raise
+        root_out = results[id(plan.root)]
+        if not isinstance(root_out, Table):
+            # sink gather: the single host-facing collect of a distributed
+            # plan (explicit when the optimizer placed Exchange(gather) at
+            # the root; implicit here otherwise)
+            root_out = root_out.to_local_table()
         wall = (time.perf_counter() - t_plan0) * 1e3
-        return PlanResult(plan, results[id(plan.root)], None, metrics,
+        return PlanResult(plan, root_out, None, metrics,
                           "eager", wall,
                           retries=sum(mm.retries for mm in metrics.values()),
                           breaker=self._breaker_snapshot(),
@@ -712,8 +775,7 @@ class PlanExecutor:
                     t0 = time.perf_counter()
                     with tracing.range_ctx(f"plan.{node.label}.degraded"):
                         out = self._exec_eager_node(node, childs, cpu_inputs,
-                                                    schemas, m,
-                                                    allow_mesh=False)
+                                                    schemas, m)
                     if self.block_per_op:
                         jax.block_until_ready([c.data for c in out.columns])
                     m.wall_ms = (time.perf_counter() - t0) * 1e3
@@ -974,8 +1036,7 @@ class PlanExecutor:
         return t
 
     def _exec_eager_node(self, node, childs: List[Table], inputs, schemas,
-                         m: OperatorMetrics,
-                         allow_mesh: bool = True) -> Table:
+                         m: OperatorMetrics) -> Table:
         ops = _ops()
         if isinstance(node, Scan):
             t = inputs[node.source]
@@ -1023,9 +1084,6 @@ class PlanExecutor:
             return ops.take_table(lt, keep.data, _has_negative=False)
         if isinstance(node, HashAggregate):
             (t,) = childs
-            if (self.mesh is not None and allow_mesh
-                    and isinstance(node.child, Exchange)):
-                return self._exec_distributed_aggregate(node, t, m)
             if not node.keys:
                 return self._global_aggregate(t, node)
             agg = ops.groupby_aggregate(t, list(node.keys),
@@ -1104,68 +1162,6 @@ class PlanExecutor:
             cols.append(Column(dtype=dt, length=1,
                                data=val[None].astype(dt.storage_dtype())))
             names.append(out_name)
-        return Table(cols, names=names)
-
-    # ---- distributed tier -------------------------------------------------
-    def _exec_distributed_aggregate(self, node: HashAggregate, t: Table,
-                                    m: OperatorMetrics) -> Table:
-        """HashAggregate over Exchange on a mesh: the parallel.relational
-        two-stage SPMD groupby, escalated via auto_retry_overflow."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        from ..parallel.autoretry import auto_retry_overflow
-        from ..parallel.relational import distributed_groupby_multi
-        if not node.keys:
-            raise PlanValidationError(
-                f"{node.label}: global aggregate has no distributed form")
-        for k in list(node.keys) + [c for c, o, _ in node.aggs
-                                    if o != "size"]:
-            if t[k].dtype.kind != dtypes.Kind.INT64 or t[k].validity is not None:
-                raise PlanValidationError(
-                    f"{node.label}: distributed aggregate supports non-null "
-                    f"INT64 columns only (got {k!r}: {t[k].dtype})")
-        n_peers = self.mesh.shape[self.mesh_axis]
-        if t.num_rows % n_peers:
-            raise PlanValidationError(
-                f"{node.label}: {t.num_rows} rows not divisible by the "
-                f"{n_peers}-way mesh")
-        val_names, agg_pairs = [], []
-        for c, o, _ in node.aggs:
-            if o in ("count", "size"):
-                agg_pairs.append((0, "count"))
-                continue
-            if o not in ("sum", "min", "max"):
-                raise PlanValidationError(
-                    f"{node.label}: distributed {o!r} unsupported "
-                    "(sum/count/min/max/size)")
-            if c not in val_names:
-                val_names.append(c)
-            agg_pairs.append((val_names.index(c), o))
-        if not val_names:
-            val_names = [node.keys[0]]      # count-only: any carrier column
-        spec = NamedSharding(self.mesh, P(self.mesh_axis))
-        keys = [jax.device_put(t[k].data, spec) for k in node.keys]
-        vals = [jax.device_put(t[v].data, spec) for v in val_names]
-        key_cap = node.key_cap or self.caps.get("key_cap") or max(
-            64, t.num_rows // n_peers)
-        attempts = 0
-
-        def run(key_cap):
-            nonlocal attempts
-            attempts += 1
-            return distributed_groupby_multi(self.mesh, keys, vals,
-                                             agg_pairs, key_cap=key_cap,
-                                             axis=self.mesh_axis)
-        (gks, outs, valid, _), final = auto_retry_overflow(
-            run, {"key_cap": key_cap}, self.max_cap_attempts)
-        m.escalations += attempts - 1
-        mask = np.asarray(valid)
-        cols = [Column(dtype=dtypes.INT64, length=int(mask.sum()),
-                       data=jnp.asarray(np.asarray(k)[mask]))
-                for k in gks]
-        for (i, op), arr in zip(agg_pairs, outs):
-            cols.append(Column(dtype=dtypes.INT64, length=int(mask.sum()),
-                               data=jnp.asarray(np.asarray(arr)[mask])))
-        names = list(node.keys) + [n for _, _, n in node.aggs]
         return Table(cols, names=names)
 
     # ---- capped tier ------------------------------------------------------

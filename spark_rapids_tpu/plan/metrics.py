@@ -40,6 +40,19 @@ class OperatorMetrics:
     io_bytes_skipped: int = 0      # compressed chunk bytes never decoded
     io_decode_ms: float = 0.0
     io_overlap_ms: float = 0.0
+    # distributed-tier metrics (docs/distributed.md). `sharding` is the
+    # operator's OUTPUT distribution ("rows@4" row-sharded over 4 peers,
+    # "hash[k]@4" hash-partitioned by k, "replicated@4", "local" gathered
+    # to one device). `exchange_how`/`exchange_bytes` record data movement:
+    # the kind (hash/broadcast/gather, plus "range" for the sample-sort's
+    # splitter exchange inside Sort/TopK) and the ICI buffer bytes it
+    # moved — on Exchange nodes for planned boundaries, on the operator
+    # itself for implicit movement (an unplanned shuffle join's internal
+    # exchange, a Sort's range partition).
+    sharding: str = ""
+    exchange_how: str = ""
+    exchange_bytes: int = 0
+    n_peers: int = 0               # mesh size the operator ran over
 
     def to_dict(self) -> Dict:
         return dataclasses.asdict(self)
@@ -93,4 +106,12 @@ def render_profile(rows: List[OperatorMetrics],
                        f"{m.io_bytes_skipped} B skipped, "
                        f"decode {m.io_decode_ms:.3f} ms, "
                        f"overlap {m.io_overlap_ms:.3f} ms")
+        if m.sharding or m.exchange_how:
+            parts = []
+            if m.sharding:
+                parts.append(f"sharding {m.sharding}")
+            if m.exchange_how:
+                parts.append(f"exchange {m.exchange_how} "
+                             f"{m.exchange_bytes} B moved")
+            out.append(f"  dist: {', '.join(parts)}")
     return "\n".join(out)

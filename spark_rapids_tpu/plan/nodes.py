@@ -437,19 +437,50 @@ class Union(PlanNode):
         return f"{len(self.inputs)} inputs"
 
 
+EXCHANGE_KINDS = ("hash", "broadcast", "gather", "identity")
+
+
 @dataclasses.dataclass(frozen=True, eq=False)
 class Exchange(PlanNode):
-    """Distribution boundary (Spark's ShuffleExchangeExec slot). On a
-    single chip it is an identity marker; when the executor is given a
-    device mesh, the operator ABOVE an Exchange runs on the distributed
-    tier (`parallel.relational` via `parallel.autoretry`), partitioned by
-    `keys`."""
+    """Distribution boundary (Spark's ShuffleExchangeExec /
+    BroadcastExchangeExec slot) — a REAL physical node on the distributed
+    tier (docs/distributed.md). `how` selects the movement:
+
+    - ``hash``: rows move to the shard given by the Spark-exact hash of
+      `keys` (pmod n_peers) — the shuffle boundary below shuffle joins and
+      two-phase aggregates. A HashAggregate directly above a hash Exchange
+      FUSES into the partial-agg → all-to-all → final-agg SPMD program
+      (the exchange ships per-group partials, not rows).
+    - ``broadcast``: the (small) relation is replicated onto every shard
+      over ICI; a join above it probes locally and its other side never
+      moves.
+    - ``gather``: the sharded relation collects onto one device — the
+      sink boundary (or the handoff into an operator with no distributed
+      form).
+    - ``identity``: no movement (the pre-distributed-tier marker shape;
+      also what every Exchange is on a single chip, where the whole node
+      is a no-op).
+
+    The optimizer's `exchange_planning` rule inserts and elides these from
+    sharding requirements and row-count estimates; `keys` is required for
+    ``hash`` and ignored otherwise."""
     child: PlanNode
     keys: Tuple[str, ...] = ()
+    how: str = ""
 
     def __post_init__(self):
         super().__post_init__()
         object.__setattr__(self, "keys", tuple(self.keys))
+        if not self.how:
+            # back-compat default: a keyed Exchange was always the hash
+            # marker, a keyless one the identity marker
+            object.__setattr__(self, "how",
+                               "hash" if self.keys else "identity")
+        _require(self.how in EXCHANGE_KINDS,
+                 f"{self.label}: exchange kind {self.how!r} not in "
+                 f"{EXCHANGE_KINDS}")
+        _require(self.how != "hash" or len(self.keys) > 0,
+                 f"{self.label}: hash exchange needs partition keys")
 
     @property
     def children(self):
@@ -463,4 +494,6 @@ class Exchange(PlanNode):
         return schema
 
     def describe(self):
-        return f"hash[{', '.join(self.keys)}]" if self.keys else "identity"
+        if self.how == "hash":
+            return f"hash[{', '.join(self.keys)}]"
+        return self.how

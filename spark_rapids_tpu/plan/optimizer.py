@@ -143,7 +143,7 @@ def plan_fingerprint(plan: Plan) -> str:
 
 RULE_NAMES = ("constant_folding", "predicate_pushdown", "limit_pushdown",
               "build_side", "column_pruning", "select_fusion",
-              "scan_pruning")
+              "scan_pruning", "exchange_planning")
 
 
 # ---- pruning-conjunct extraction (shared with the executor's scan IO) -------
@@ -201,6 +201,12 @@ class OptimizeReport:
     source_fingerprint: str = ""
     fingerprint: str = ""
     fell_back: bool = False
+    # distributed planning (exchange_planning rule, docs/distributed.md):
+    # Exchange insertions per kind, elisions (a boundary the partitioning
+    # already satisfied), and the final plan's per-node sharding specs
+    exchanges: Dict[str, int] = dataclasses.field(default_factory=dict)
+    exchanges_elided: int = 0
+    sharding: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     def rules_fired(self) -> Dict[str, int]:
         return {k: v for k, v in self.rules.items() if v}
@@ -214,7 +220,10 @@ class OptimizeReport:
                 "pruned_bytes_est": self.pruned_bytes_est,
                 "fingerprint": self.fingerprint,
                 "source_fingerprint": self.source_fingerprint,
-                "fell_back": self.fell_back}
+                "fell_back": self.fell_back,
+                "exchanges": dict(self.exchanges),
+                "exchanges_elided": self.exchanges_elided,
+                "sharding": dict(self.sharding)}
 
     def summary(self) -> str:
         lines = [f"optimizer: {self.passes} pass(es), "
@@ -226,6 +235,15 @@ class OptimizeReport:
         if self.pruned_columns:
             lines.append(f"  pruned {self.pruned_columns} column(s) "
                          f"(~{self.pruned_bytes_est} bytes est)")
+        if self.exchanges or self.exchanges_elided:
+            placed = ", ".join(f"{k}={v}" for k, v in
+                               sorted(self.exchanges.items()) if v)
+            lines.append(f"  exchanges: {placed or 'none'}, "
+                         f"{self.exchanges_elided} elided")
+        if self.sharding:
+            lines.append("  sharding:")
+            for label, spec in self.sharding.items():
+                lines.append(f"    {label}: {spec}")
         lines.append(f"  fingerprint {self.source_fingerprint} -> "
                      f"{self.fingerprint}")
         return "\n".join(lines)
@@ -758,6 +776,144 @@ _RULES = (
 )
 
 
+# ---- exchange planning (distributed tier, docs/distributed.md) --------------
+
+def _statically_distributable(n: PlanNode, float_inputs: bool) -> bool:
+    """Whether a node kind CAN run on the mesh — the static half of the
+    gate (the executor re-checks runtime properties like column dtypes and
+    gathers gracefully when they fail). Limit and global aggregates have
+    no distributed form; `mean` and any-float inputs disable aggregates
+    (the exchange accumulates partials in exact int64)."""
+    if isinstance(n, Limit):
+        return False
+    if isinstance(n, HashAggregate):
+        if not n.keys or any(o == "mean" for _, o, _ in n.aggs):
+            return False
+        if float_inputs:
+            return False
+    return True
+
+
+def _plan_exchanges(root: PlanNode, ctx: "_Ctx", n_peers: int):
+    """Post-fixpoint distributed planning: walk the DAG bottom-up tracking
+    each node's hash-partitioning property (plan/distributed.transfer_part
+    — the SAME rule the runtime rels follow) and insert the Exchange
+    boundaries the mesh execution needs:
+
+    - each shuffle-join side gets Exchange(hash, its keys) unless the
+      side is already partitioned by exactly that key tuple (ELIDED);
+    - a join whose build (right) side estimate is at or below
+      `config.broadcast_rows()` — and no larger than the probe side —
+      gets Exchange(broadcast) instead: the small side replicates, the
+      probe side never moves (est_rows-driven, Spark's
+      autoBroadcastJoinThreshold shape);
+    - a keyed HashAggregate gets Exchange(hash, group keys) below it
+      (the executor FUSES the pair into the two-phase partial-agg
+      program) unless the input partitioning already co-locates every
+      group — a subset of the group keys suffices — in which case the
+      boundary is elided and the aggregate merges shard-locally;
+    - sharded relations flowing into an operator with NO distributed
+      form — and the plan root — get Exchange(gather): the only
+      hops off the mesh, visible in explain().
+
+    Returns (new root, insertions); fills report.exchanges/
+    exchanges_elided/sharding."""
+    from .. import config
+    from .distributed import part_satisfies, transfer_part
+    report = ctx.report
+    nodes = _toposort(root)
+    if any(ctx.schemas.of(n) is None for n in nodes):
+        return root, 0
+    thresh = config.broadcast_rows()
+    stats = {"hash": 0, "broadcast": 0, "gather": 0}
+    elided = [0]
+    sharded: Dict[int, bool] = {}
+    part: Dict[int, frozenset] = {}
+    memo: Dict[int, PlanNode] = {}
+    gathers: Dict[int, PlanNode] = {}   # one gather per shared child
+
+    def add_exchange(child: PlanNode, keys, how: str) -> PlanNode:
+        if how == "gather" and id(child) in gathers:
+            return gathers[id(child)]
+        stats[how] += 1
+        ex = Exchange(child, tuple(keys), how=how)
+        part[id(ex)] = transfer_part(ex, [part[id(child)]])
+        sharded[id(ex)] = how != "gather"
+        if how == "gather":
+            gathers[id(child)] = ex
+        return ex
+
+    def go(n: PlanNode) -> PlanNode:
+        got = memo.get(id(n))
+        if got is not None:
+            return got
+        kids = [go(c) for c in n.children]
+        on_mesh = _statically_distributable(n, ctx.float_inputs) and (
+            isinstance(n, Scan) or (bool(kids)
+                                    and all(sharded[id(k)] for k in kids)))
+        if not on_mesh:
+            # graceful boundary: sharded children collect here
+            kids = [add_exchange(k, (), "gather") if sharded[id(k)] else k
+                    for k in kids]
+        elif isinstance(n, HashJoin):
+            l_new, r_new = kids
+            le = ctx.est.of(n.left)
+            re_ = ctx.est.of(n.right)
+            if (re_ is not None and re_ <= thresh
+                    and (le is None or re_ <= le)):
+                r_new = add_exchange(r_new, (), "broadcast")
+            else:
+                if tuple(n.left_keys) in part[id(l_new)]:
+                    elided[0] += 1
+                else:
+                    l_new = add_exchange(l_new, n.left_keys, "hash")
+                if tuple(n.right_keys) in part[id(r_new)]:
+                    elided[0] += 1
+                else:
+                    r_new = add_exchange(r_new, n.right_keys, "hash")
+            kids = [l_new, r_new]
+        elif isinstance(n, HashAggregate):
+            (c_new,) = kids
+            if isinstance(c_new, Exchange) and c_new.how == "hash":
+                pass                    # authored boundary, keep it
+            elif part_satisfies(part[id(c_new)], n.keys):
+                elided[0] += 1          # input already co-locates groups
+            else:
+                kids = [add_exchange(c_new, n.keys, "hash")]
+        node2 = (_with_children(n, tuple(kids))
+                 if any(k is not c for k, c in zip(kids, n.children)) else n)
+        sharded[id(node2)] = on_mesh
+        part[id(node2)] = (transfer_part(
+            node2, [part[id(k)] for k in node2.children])
+            if on_mesh else frozenset())
+        memo[id(n)] = node2
+        return node2
+
+    new_root = go(root)
+    if sharded[id(new_root)]:
+        new_root = add_exchange(new_root, (), "gather")   # the sink
+
+    for node in _toposort(new_root):
+        if isinstance(node, Exchange) and node.how != "identity":
+            if node.how == "gather":
+                spec = "local (gather)"
+            elif node.how == "broadcast":
+                spec = f"replicated@{n_peers}"
+            else:
+                spec = f"hash[{','.join(node.keys)}]@{n_peers}"
+        elif not sharded.get(id(node), False):
+            spec = "local"
+        elif part.get(id(node)):
+            keys = min(part[id(node)])
+            spec = f"hash[{','.join(keys)}]@{n_peers}"
+        else:
+            spec = f"rows@{n_peers}"
+        report.sharding[node.label] = spec
+    report.exchanges = stats
+    report.exchanges_elided = elided[0]
+    return new_root, sum(stats.values())
+
+
 # ---- pipeline ---------------------------------------------------------------
 
 def optimize(plan: Plan,
@@ -765,7 +921,8 @@ def optimize(plan: Plan,
              bound_rows: Optional[Dict[str, int]] = None,
              max_passes: int = MAX_PASSES,
              float_inputs: bool = False,
-             streaming_sources=frozenset()) -> Tuple[Plan, OptimizeReport]:
+             streaming_sources=frozenset(),
+             mesh_peers: Optional[int] = None) -> Tuple[Plan, OptimizeReport]:
     """Run the rule pipeline to fixpoint over `plan`. `bound` maps scan
     source -> actual column names and `bound_rows` -> actual row counts
     (execute() passes both; explain-time callers may pass neither and the
@@ -775,9 +932,14 @@ def optimize(plan: Plan,
     `streaming_sources` names the scans bound to streaming (parquet)
     sources this execution — the scan_pruning rule fires only for those
     (a Scan carrying its own `parquet` binding qualifies regardless).
-    Returns the optimized Plan (the SAME object when nothing fired) + the
-    report."""
-    report = OptimizeReport(rules={name: 0 for name, _ in _RULES})
+    `mesh_peers` (the meshed eager executor passes its mesh width) runs
+    the `exchange_planning` rule once AFTER the fixpoint: Exchange(hash|
+    broadcast|gather) boundaries are inserted/elided for the distributed
+    tier (docs/distributed.md) — after, because the logical rules must
+    not thrash against the physical boundary nodes they'd have to move
+    through. Returns the optimized Plan (the SAME object when nothing
+    fired) + the report."""
+    report = OptimizeReport(rules={name: 0 for name in RULE_NAMES})
     report.source_fingerprint = plan.fingerprint
     streaming = frozenset(streaming_sources)
     root = plan.root
@@ -792,6 +954,10 @@ def optimize(plan: Plan,
         report.passes = p + 1
         if not pass_hits:
             break
+    if mesh_peers is not None and mesh_peers > 1:
+        ctx = _Ctx(root, bound, bound_rows, report, float_inputs, streaming)
+        root, n = _plan_exchanges(root, ctx, mesh_peers)
+        report.rules["exchange_planning"] += n
     if root is plan.root:
         report.fingerprint = report.source_fingerprint
         return plan, report
@@ -804,9 +970,12 @@ def optimize(plan: Plan,
         # parity gate reading rules_fired/pruned_columns would otherwise
         # celebrate rewrites that never executed
         report.fell_back = True
-        report.rules = {name: 0 for name, _ in _RULES}
+        report.rules = {name: 0 for name in RULE_NAMES}
         report.pruned_columns = 0
         report.pruned_bytes_est = 0
+        report.exchanges = {}
+        report.exchanges_elided = 0
+        report.sharding = {}
         report.fingerprint = report.source_fingerprint
         return plan, report
     report.fingerprint = opt.fingerprint

@@ -516,6 +516,19 @@ def test_scalar_agg_as_bare_projection():
     assert resc.compact().to_pydict() == res.table.to_pydict()
 
 
-def test_capped_executor_rejects_mesh():
-    with pytest.raises(ValueError, match="eager tier"):
-        PlanExecutor(mode="capped", mesh=object())
+def test_capped_executor_rejects_mesh_per_plan():
+    """mesh + mode="capped" is a PER-PLAN error now: only a plan that
+    actually contains a distributed-lowerable operator is rejected, and
+    the error names the offending node; a trivial row-wise plan runs
+    capped (the mesh is irrelevant to it)."""
+    from spark_rapids_tpu.plan import PlanValidationError
+    ex = PlanExecutor(mode="capped", mesh=object())   # no blanket raise
+    sales, dims = _tables(n=100)
+    with pytest.raises(PlanValidationError,
+                       match=r"HashJoin#\d+.*eager tier"):
+        ex.execute(_plan(), {"sales": sales, "dims": dims})
+    b = PlanBuilder()
+    rowwise = (b.scan("sales", schema=["k", "v"])
+                .filter(col("v") > 0).limit(5).build())
+    res = ex.execute(rowwise, {"sales": sales})
+    assert res.compact().num_rows <= 5
