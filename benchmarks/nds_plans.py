@@ -193,6 +193,10 @@ def run_plan_variants(bench: str, axes: dict, plan, inputs, *,
             rules = res.optimizer["rules_fired"]
             extra["pruned_columns"] = res.optimizer["pruned_columns"]
             extra["fell_back"] = res.optimizer["fell_back"]
+            if res.optimizer.get("fallback"):
+                # the verifier's precise diagnostic (which rule, which
+                # node, which invariant) — never a bare fell_back flag
+                extra["fallback"] = res.optimizer["fallback"]
             # the win the pruned columns bought, in per-op metric terms
             extra["plan_bytes_saved"] = (totals["off"]["plan_bytes_out"]
                                          - totals["on"]["plan_bytes_out"])

@@ -1,0 +1,68 @@
+"""Nightly deep-fuzz stage (ci/nightly.sh, docs/analysis.md).
+
+Runs the property-based plan fuzzer (spark_rapids_tpu/analysis/fuzz.py)
+over a seeded sweep of >=200 random plans — far past the fixed premerge
+corpus — asserting every case:
+
+- verifies under the static plan verifier (authored AND optimized form,
+  with per-rule re-validation enabled);
+- never makes the optimizer fall back;
+- (small plans) executes with optimized-vs-unoptimized eager parity,
+  including error parity.
+
+Emits one JSONL summary row via benchmarks/common.emit_record with the
+seed window, case/executed counts, node-kind coverage and wall time, so
+the bench history shows the sweep's trajectory; any failing seed fails
+the stage and is replayable with
+`python -m spark_rapids_tpu.analysis.fuzz --start <seed> --count 1 -v`.
+"""
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from benchmarks.common import emit_record, parse_args      # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(add_help=False)
+    ap.add_argument("--seed0", type=int, default=1000)
+    ap.add_argument("--count", type=int, default=200)
+    ap.add_argument("--max-ops", type=int, default=8)
+    extra, rest = ap.parse_known_args(argv)
+    args = parse_args(rest)                      # --scale/--iters/--cpu
+    count = max(int(extra.count * max(args.scale, 0.05)), 50) \
+        if args.scale != 1.0 else extra.count
+
+    from spark_rapids_tpu.analysis.fuzz import run_corpus
+    t0 = time.perf_counter()
+    summary = run_corpus(range(extra.seed0, extra.seed0 + count),
+                         execute=True, max_ops=extra.max_ops)
+    ms = (time.perf_counter() - t0) * 1e3
+    emit_record("plan_fuzz", {"seed0": extra.seed0, "count": count,
+                              "max_ops": extra.max_ops},
+                ms, n_rows=summary["cases"], impl="plan_eager",
+                fuzz_cases=summary["cases"],
+                fuzz_executed=summary["executed"],
+                fuzz_failures=len(summary["failures"]),
+                fuzz_kinds=",".join(summary["kinds_covered"]))
+    # report replayable seeds FIRST: a verify/fallback failure also skips
+    # execution, and dying on a count assert would swallow the seed the
+    # stage's whole contract is to surface
+    if summary["failures"]:
+        for f in summary["failures"]:
+            print(f"FAIL seed {f['seed']}: {f['error']}", file=sys.stderr)
+        raise SystemExit(1)
+    assert summary["executed"] == summary["cases"], \
+        "fuzz: not every case executed"
+    # the sweep must exercise the full node vocabulary or it is not the
+    # gate it claims to be
+    from spark_rapids_tpu.analysis.fuzz import ALL_KINDS
+    missing = set(ALL_KINDS) - set(summary["kinds_covered"])
+    assert not missing, f"fuzz corpus never generated {sorted(missing)}"
+    print(f"plan fuzz OK ({count} plans)", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

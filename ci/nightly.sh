@@ -41,6 +41,13 @@ JAX_PLATFORMS=cpu python benchmarks/streaming_scan.py --scale 0.5 --cpu
 # fields
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python benchmarks/distributed_parity.py --scale 0.2 --cpu
+# deep plan fuzz (docs/analysis.md): a seeded sweep of >=200 random plans
+# over all 11 operator kinds — static verification (authored + optimized,
+# per-rule re-validation), no optimizer fall-backs, and small-plan eager
+# parity optimized-vs-unoptimized (error parity included); emits one
+# JSONL summary row, and any failing seed replays standalone via
+# `python -m spark_rapids_tpu.analysis.fuzz --start <seed> --count 1 -v`
+JAX_PLATFORMS=cpu python benchmarks/plan_fuzz.py --seed0 1000 --count 200 --cpu
 ./ci/fuzz-test.sh
 ./ci/sanitizer.sh
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -5,6 +5,16 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 python -c "import spark_rapids_tpu; print('import ok:', spark_rapids_tpu.__name__)"
+# JAX-hazard linter (tools/lint_hazards.py, docs/analysis.md): AST-checks
+# the known hazard patterns (self capture in jit closure caches, host
+# sync on traced values, tracer branches, env reads outside config.py,
+# nondeterministic iteration feeding fingerprints); vetted exceptions
+# live in tools/lint_hazards_allowlist.txt with one-line justifications
+python tools/lint_hazards.py spark_rapids_tpu
+# fixed fuzz corpus (analysis/fuzz.py): 24 seeded random plans covering
+# all 11 node kinds — verify + optimize (per-rule re-validation) + eager
+# optimized-vs-unoptimized parity; the nightly runs the deep sweep
+JAX_PLATFORMS=cpu python -m spark_rapids_tpu.analysis.fuzz --start 0 --count 24 --cpu
 python -m pytest tests/ -x -q
 python benchmarks/run_all.py --scale 0.002 --iters 2 --cpu
 python tools/monte_carlo.py --tasks 16 --parallelism 4 --gpu-mib 512 \

@@ -1,0 +1,32 @@
+"""Static analysis over physical plans (docs/analysis.md).
+
+The engine's value proposition is Spark-exact semantics, yet two of the
+last PRs shipped soundness bugs only human review caught: a stale
+partitioning claim that let `exchange_planning` elide a required shuffle
+(silently duplicating/dropping groups), and a bound-method capture in a
+process-global jitted-primitive cache that pinned dead executors. This
+package turns those one-off review findings into a permanent machine
+check that gates every optimizer rule, executor tier and plan:
+
+- `verifier`: the static plan verifier — symbolic schema/dtype
+  propagation, sharding/partitioning soundness (re-derived bottom-up with
+  the SAME `transfer_part` transfer function the runtime uses), and
+  rewrite-pair legality checks mirroring each optimizer rule's side
+  conditions. Wired as the builder's validation backend, a debug-mode
+  pre-execution gate (`SPARK_RAPIDS_TPU_VERIFY_PLANS`, on in tests), and
+  the optimizer's per-rule fall-back diagnostic.
+- `fuzz`: the property-based plan fuzzer — a seeded random DAG generator
+  over all 11 operator kinds whose cases must verify, optimize cleanly,
+  and (being small) execute with optimized-vs-unoptimized eager parity.
+  A fixed corpus runs premerge; a deep seeded sweep runs nightly.
+
+The AST-level sibling is `tools/lint_hazards.py`: the codebase linter for
+the known JAX hazard patterns (self capture in jit closure caches,
+host-sync on traced values, tracer branches, env reads outside config.py,
+nondeterministic iteration feeding fingerprints).
+"""
+from .verifier import (PlanVerificationError, VerifyReport, Violation,
+                       verify, verify_rewrite)
+
+__all__ = ["PlanVerificationError", "VerifyReport", "Violation",
+           "verify", "verify_rewrite"]

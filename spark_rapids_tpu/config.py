@@ -26,6 +26,7 @@ equivalents, all read at use time (not import time) so tests can monkeypatch:
 | SPARK_RAPIDS_TPU_IO_CHUNK_ROWS   | 0    | streaming-scan morsel row bound (0 = one chunk per row group) |
 | SPARK_RAPIDS_TPU_BROADCAST_ROWS  | 8192 | distributed tier: estimated build-side rows at or below which exchange_planning picks a broadcast join over a shuffle |
 | SPARK_RAPIDS_TPU_DIST_SLACK      | 2.0  | distributed tier: initial per-bucket slack factor for hash/range exchanges (grows geometrically on overflow) |
+| SPARK_RAPIDS_TPU_VERIFY_PLANS    | 0    | static plan verifier gate (analysis/verifier.py): 1 verifies every plan pre-execution and every optimizer rule's output; on in tests (conftest), off in production |
 
 The SPARK_RAPIDS_TPU_BREAKER_* numeric knobs are snapshotted when a
 `DeviceHealthMonitor` is constructed (one policy per monitor lifetime —
@@ -172,6 +173,30 @@ def dist_slack() -> float:
     raises the overflow flag and the executor retries with geometrically
     grown slack (SplitAndRetry contract, parallel/autoretry.py)."""
     return _float_env("SPARK_RAPIDS_TPU_DIST_SLACK", 2.0)
+
+
+def verify_plans() -> bool:
+    """Static plan verifier gate (analysis/verifier.py, docs/analysis.md):
+    when on, PlanExecutor.execute() verifies the (optimized) plan before
+    any tier runs it, and the optimizer verifies every rule's output
+    instead of only net-validating the pipeline's end state. Debug-mode:
+    on in the test suite (tests/conftest.py), off by default in
+    production. Same strict-typo policy as the kernel selectors — a typo
+    must not silently disable a soundness gate."""
+    v = os.environ.get("SPARK_RAPIDS_TPU_VERIFY_PLANS", "0")
+    if v not in ("0", "1", "on", "off"):
+        raise ValueError(
+            f"SPARK_RAPIDS_TPU_VERIFY_PLANS={v!r}: expected 0, 1, on, "
+            "or off")
+    return v in ("1", "on")
+
+
+def faultinj_config_path() -> str:
+    """Fault-injector config path (TPU_FAULT_INJECTOR_CONFIG_PATH — the
+    reference's FAULT_INJECTOR_CONFIG_PATH analogue). Lives here so the
+    hazard linter's env-reads-outside-config rule holds for faultinj.py
+    too; empty string when unset."""
+    return os.environ.get("TPU_FAULT_INJECTOR_CONFIG_PATH", "")
 
 
 def groupby_kernel() -> str:

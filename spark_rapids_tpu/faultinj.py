@@ -238,7 +238,8 @@ def install(config_path: Optional[str] = None) -> FaultInjector:
     uninstall() first to swap interception points.
     """
     global _active
-    path = config_path or os.environ.get(ENV_CONFIG_PATH)
+    from . import config as _config
+    path = config_path or _config.faultinj_config_path()
     if not path:
         raise ValueError(f"no config path given and ${ENV_CONFIG_PATH} unset")
     if _active is not None:
@@ -296,7 +297,8 @@ def uninstall() -> None:
 def maybe_install_from_env() -> None:
     """Package-import hook: activate when the env var is set, exactly like
     the reference loading libcufaultinj.so via CUDA_INJECTION64_PATH."""
-    if os.environ.get(ENV_CONFIG_PATH):
+    from . import config as _config
+    if _config.faultinj_config_path():
         try:
             install()
         except (OSError, ValueError) as e:

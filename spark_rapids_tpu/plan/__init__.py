@@ -37,6 +37,13 @@ concerns live in ONE executor:
 - `metrics`: `explain()` (pre-run plan tree) and `profile()` (post-run
   per-operator rows/bytes/wall-time/retry counts).
 
+Build-time validation, execute()'s bind-time re-resolution, and the
+debug-mode pre-execution gate (`SPARK_RAPIDS_TPU_VERIFY_PLANS`) all
+route through the static plan verifier (`spark_rapids_tpu.analysis`,
+docs/analysis.md) — one error vocabulary of invariant codes naming the
+offending operator, from the builder to the optimizer's fall-back
+diagnostics.
+
 See docs/plan.md for the operator contract and how a JVM/plugin front-end
 targets this layer.
 """

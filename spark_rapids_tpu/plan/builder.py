@@ -49,13 +49,14 @@ class Plan:
         self.root = root
         self.nodes = _toposort(root)
         self.scans = [n for n in self.nodes if isinstance(n, Scan)]
-        sources = [s.source for s in self.scans]
-        dup = {s for s in sources if sources.count(s) > 1}
-        if dup:
-            raise PlanValidationError(
-                f"multiple Scan nodes bind the same input(s) {sorted(dup)}; "
-                "reuse one Scan node (the DAG executes it once)")
-        self.schemas = self.resolve_schemas(strict=False)
+        # build-time validation routes through the static verifier
+        # (analysis/verifier.py, docs/analysis.md), so builder-time and
+        # execute-time diagnostics share one error vocabulary: a
+        # PlanVerificationError (still a PlanValidationError) whose
+        # violations carry an invariant code + the offending operator's
+        # label. Lazy import: analysis pulls heavier plan modules.
+        from ..analysis import verifier
+        self.schemas = verifier.check_build(self)
 
     # ---- validation -------------------------------------------------------
     def resolve_schemas(self, bound: Optional[Dict[str, Sequence[str]]] = None,
@@ -63,41 +64,11 @@ class Plan:
         """node-id -> output names. `bound` gives scan schemas from actual
         tables (overriding declarations, which are then cross-checked).
         strict=False skips subtrees fed by undeclared scans instead of
-        raising (build-time pass)."""
-        bound = bound or {}
-        out: Dict[int, Tuple[str, ...]] = {}
-        for node in self.nodes:
-            if isinstance(node, Scan):
-                schema = bound.get(node.source, node.schema)
-                if schema is None and not strict:
-                    continue
-                if schema is None:
-                    raise PlanValidationError(
-                        f"{node.label}: input {node.source!r} is not bound "
-                        f"and no schema was declared")
-                schema = tuple(schema)
-                if node.schema is not None and tuple(node.schema) != schema:
-                    raise PlanValidationError(
-                        f"{node.label}: bound table schema {list(schema)} "
-                        f"does not match declared {list(node.schema)}")
-                # the optimizer's pruned projection narrows the OUTPUT; the
-                # declared/bound cross-check above ran on the full schema
-                out[id(node)] = node.apply_projection(schema)
-                continue
-            child_schemas = []
-            ok = True
-            for c in node.children:
-                if id(c) not in out:
-                    ok = False        # fed by an undeclared scan
-                    break
-                child_schemas.append(out[id(c)])
-            if not ok:
-                if strict:
-                    raise PlanValidationError(
-                        f"{node.label}: child schema unresolved")
-                continue
-            out[id(node)] = tuple(node.output_names(child_schemas))
-        return out
+        raising (build-time pass). Delegates to the static verifier's
+        schema-propagation layer — the single home of the
+        `output_names` contract's error vocabulary."""
+        from ..analysis import verifier
+        return verifier.resolve_schemas(self.nodes, bound, strict)
 
     @property
     def input_names(self) -> List[str]:

@@ -413,6 +413,11 @@ class PlanExecutor:
                          else bool(optimize))
         self._opt_cache = _LruDict(64)  # (root, bound sig) -> (plan, schemas,
         #                                 report): one rewrite per binding
+        self._verify_cache = _LruDict(128)  # passed pre-execution-gate
+        #                                 verdicts: repeat executions of a
+        #                                 cached (plan, binding) rewrite
+        #                                 skip re-verification (failures
+        #                                 raise and are never cached)
         self._jit_cache: Dict[Tuple, Tuple[Callable, Dict]] = _LruDict(64)
         # escalated capacities survive per plan STRUCTURE (keyed by the
         # canonical fingerprint — optimizer.plan_fingerprint), so the next
@@ -459,8 +464,12 @@ class PlanExecutor:
         bound = {name: tuple(t.names) for name, t in inputs.items()}
         schemas = plan.resolve_schemas(bound)
         report = None
+        authored = plan
         if self.optimize:
             plan, schemas, report = self._optimized(plan, inputs, bound)
+        from .. import config
+        if config.verify_plans():
+            self._verify_execution(authored, plan, report, inputs, bound)
         if self.session is not None:
             from ..runtime.admission import active_session
             with active_session(self.session):
@@ -470,6 +479,45 @@ class PlanExecutor:
         if report is not None:
             res.optimizer = report.to_dict()
         return res
+
+    def _verify_execution(self, authored, plan, report, inputs, bound):
+        """Debug-mode pre-execution gate (SPARK_RAPIDS_TPU_VERIFY_PLANS,
+        on in tests — docs/analysis.md): the plan about to run must pass
+        the static verifier. Schema propagation and (for Table bindings)
+        dtype typing always check; the rewrite-pair invariants check when
+        the optimizer ran; partitioning soundness checks when
+        exchange_planning placed distributed boundaries. Raises
+        PlanVerificationError naming the invariant and operator."""
+        from ..analysis import verifier
+        input_dtypes = {
+            name: {cn: c.dtype for cn, c in zip(t.names, t.columns)}
+            for name, t in inputs.items() if isinstance(t, Table)}
+        floats = any(_input_has_floats(t) for t in inputs.values())
+        planned = (report is not None and not report.fell_back
+                   and self.mesh is not None and self.mode == "eager"
+                   and self.mesh.shape[self.mesh_axis] > 1)
+        # verdicts memoize on everything the checks read — a repeat
+        # execution of the same (plan, binding) pays nothing, the same
+        # contract as the rewrite cache feeding it
+        key = (authored.root, plan.root, tuple(sorted(bound.items())),
+               tuple((n, tuple(repr(d) for d in cols.values()))
+                     for n, cols in sorted(input_dtypes.items())),
+               floats, planned,
+               None if report is None else (report.fingerprint,
+                                            report.fell_back))
+        if self._verify_cache.get(key):
+            return
+        if report is None and plan is authored:
+            rep = verifier.verify(plan, bound=bound,
+                                  input_dtypes=input_dtypes,
+                                  float_inputs=floats)
+        else:
+            rep = verifier.verify_rewrite(authored, plan, bound=bound,
+                                          input_dtypes=input_dtypes,
+                                          float_inputs=floats,
+                                          planned=planned, report=report)
+        rep.raise_if_failed("pre-execution gate")
+        self._verify_cache[key] = True
 
     def _optimized(self, plan, inputs, bound):
         """Rewrite `plan` through the rule pipeline, once per (plan,
@@ -495,15 +543,18 @@ class PlanExecutor:
                       if self.mesh is not None and self.mode == "eager"
                       else None)
         bc_rows = config.broadcast_rows() if mesh_peers else None
+        # verify mode changes which plan survives a mid-pipeline invalid
+        # rewrite (per-rule fall-back), so it belongs in the cache key too
+        verify_rules = config.verify_plans()
         key = (plan.root, tuple(sorted(bound.items())),
                tuple(sorted((n, t.num_rows) for n, t in inputs.items())),
-               floats, streaming, mesh_peers, bc_rows)
+               floats, streaming, mesh_peers, bc_rows, verify_rules)
         hit = self._opt_cache.get(key)
         if hit is None:
             opt, report = run_optimizer(
                 plan, bound, {n: t.num_rows for n, t in inputs.items()},
                 float_inputs=floats, streaming_sources=streaming,
-                mesh_peers=mesh_peers)
+                mesh_peers=mesh_peers, verify_rules=verify_rules)
             hit = (opt, opt.resolve_schemas(bound), report)
             self._opt_cache[key] = hit
         return hit

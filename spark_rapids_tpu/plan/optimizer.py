@@ -201,6 +201,11 @@ class OptimizeReport:
     source_fingerprint: str = ""
     fingerprint: str = ""
     fell_back: bool = False
+    # precise fall-back diagnostic (analysis/verifier.py): which rule
+    # produced the invalid rewrite, which node, which invariant —
+    # surfaced by summary(), PlanResult.optimizer and the bench JSONL
+    # instead of the bare fell_back flag
+    fallback: Optional[Dict] = None
     # distributed planning (exchange_planning rule, docs/distributed.md):
     # Exchange insertions per kind, elisions (a boundary the partitioning
     # already satisfied), and the final plan's per-node sharding specs
@@ -221,6 +226,7 @@ class OptimizeReport:
                 "fingerprint": self.fingerprint,
                 "source_fingerprint": self.source_fingerprint,
                 "fell_back": self.fell_back,
+                "fallback": dict(self.fallback) if self.fallback else None,
                 "exchanges": dict(self.exchanges),
                 "exchanges_elided": self.exchanges_elided,
                 "sharding": dict(self.sharding)}
@@ -230,6 +236,11 @@ class OptimizeReport:
                  f"{self.total_rewrites()} rewrite(s)"
                  + (" [FELL BACK: re-validation failed, authored plan ran]"
                     if self.fell_back else "")]
+        if self.fallback:
+            lines.append(f"  fell back on rule={self.fallback.get('rule')} "
+                         f"node={self.fallback.get('node')} "
+                         f"invariant={self.fallback.get('invariant')}: "
+                         f"{self.fallback.get('message')}")
         for name, n in self.rules_fired().items():
             lines.append(f"  {name}: {n}")
         if self.pruned_columns:
@@ -914,6 +925,98 @@ def _plan_exchanges(root: PlanNode, ctx: "_Ctx", n_peers: int):
     return new_root, sum(stats.values())
 
 
+# ---- fall-back diagnostics (analysis/verifier.py, docs/analysis.md) ---------
+
+def _plan_error(root: PlanNode, bound=None) -> Optional[PlanValidationError]:
+    """Re-validate a rewritten root; the schema error (None when clean).
+    Plan construction routes through the static verifier, so the error
+    carries structured violations naming the invariant and node. `bound`
+    matters: a Scan with no declared schema resolves only against the
+    bound tables, so without it an invalid rewrite over such a plan
+    validates vacuously here and detonates later inside a DIFFERENT
+    rule's schema resolution — the victim, not the culprit."""
+    try:
+        p = Plan(root)
+        if bound:
+            p.resolve_schemas(bound)
+    except PlanValidationError as e:
+        return e
+    return None
+
+
+def _diagnose(rule: str, err: PlanValidationError) -> Dict:
+    """The (rule, node, invariant, message) fall-back record. Verifier
+    errors carry structured violations; a bare PlanValidationError falls
+    back to parsing the leading `Kind#id:` label convention."""
+    violations = getattr(err, "violations", None)
+    if violations:
+        v = violations[0]
+        return {"rule": rule, "node": v.node, "invariant": v.invariant,
+                "message": v.message}
+    msg = str(err)
+    head = msg.split(":", 1)[0]
+    node = head if "#" in head and " " not in head else ""
+    return {"rule": rule, "node": node, "invariant": "schema",
+            "message": msg}
+
+
+def _fall_back(plan: Plan, report: OptimizeReport):
+    """Discard the rewrite and run the authored plan. The report must
+    describe what RAN, so the discarded rewrite's counts are zeroed: a
+    parity gate reading rules_fired/pruned_columns would otherwise
+    celebrate rewrites that never executed. `report.fallback` (set by the
+    caller) survives — it describes why the rewrite was discarded."""
+    report.fell_back = True
+    report.rules = {name: 0 for name in RULE_NAMES}
+    report.pruned_columns = 0
+    report.pruned_bytes_est = 0
+    report.exchanges = {}
+    report.exchanges_elided = 0
+    report.sharding = {}
+    report.fingerprint = report.source_fingerprint
+    return plan, report
+
+
+def _attribute_fallback(plan: Plan, bound, bound_rows, float_inputs,
+                        streaming, mesh_peers,
+                        err: PlanValidationError) -> Dict:
+    """Post-hoc attribution for the validate-or-fall-back net: re-run the
+    pipeline from the authored root, re-validating after every rule that
+    rewrites, to name the rule/node/invariant that produced the invalid
+    DAG. Only runs on the (defensively impossible) fall-back path, so the
+    duplicated rule work costs nothing in the common case."""
+    scratch = OptimizeReport(rules={name: 0 for name in RULE_NAMES})
+    root = plan.root
+    for _ in range(MAX_PASSES):
+        pass_hits = 0
+        for name, rule in _RULES:
+            ctx = _Ctx(root, bound, bound_rows, scratch, float_inputs,
+                       streaming)
+            try:
+                new_root, n = rule(root, ctx)
+            except PlanValidationError as bad:
+                return _diagnose(name, bad)   # the rule itself blew up
+            if new_root is not root:
+                bad = _plan_error(new_root, bound)
+                if bad is not None:
+                    return _diagnose(name, bad)
+            root = new_root
+            pass_hits += n
+        if not pass_hits:
+            break
+    if mesh_peers is not None and mesh_peers > 1:
+        ctx = _Ctx(root, bound, bound_rows, scratch, float_inputs,
+                   streaming)
+        try:
+            new_root, _ = _plan_exchanges(root, ctx, mesh_peers)
+        except PlanValidationError as bad:
+            return _diagnose("exchange_planning", bad)
+        bad = _plan_error(new_root, bound)
+        if bad is not None:
+            return _diagnose("exchange_planning", bad)
+    return _diagnose("unknown", err)
+
+
 # ---- pipeline ---------------------------------------------------------------
 
 def optimize(plan: Plan,
@@ -922,7 +1025,8 @@ def optimize(plan: Plan,
              max_passes: int = MAX_PASSES,
              float_inputs: bool = False,
              streaming_sources=frozenset(),
-             mesh_peers: Optional[int] = None) -> Tuple[Plan, OptimizeReport]:
+             mesh_peers: Optional[int] = None,
+             verify_rules: bool = False) -> Tuple[Plan, OptimizeReport]:
     """Run the rule pipeline to fixpoint over `plan`. `bound` maps scan
     source -> actual column names and `bound_rows` -> actual row counts
     (execute() passes both; explain-time callers may pass neither and the
@@ -937,47 +1041,78 @@ def optimize(plan: Plan,
     broadcast|gather) boundaries are inserted/elided for the distributed
     tier (docs/distributed.md) — after, because the logical rules must
     not thrash against the physical boundary nodes they'd have to move
-    through. Returns the optimized Plan (the SAME object when nothing
-    fired) + the report."""
+    through. `verify_rules` (the executor passes
+    `config.verify_plans()`, on in tests) re-validates EVERY rule's
+    output as it lands instead of only net-validating the pipeline's end
+    state — the first invalid rewrite falls back immediately with a
+    precise (rule, node, invariant) diagnostic in `report.fallback`.
+    Returns the optimized Plan (the SAME object when nothing fired) +
+    the report."""
     report = OptimizeReport(rules={name: 0 for name in RULE_NAMES})
     report.source_fingerprint = plan.fingerprint
     streaming = frozenset(streaming_sources)
     root = plan.root
-    for p in range(max_passes):
-        pass_hits = 0
-        for name, rule in _RULES:
+    try:
+        for p in range(max_passes):
+            pass_hits = 0
+            for name, rule in _RULES:
+                ctx = _Ctx(root, bound, bound_rows, report, float_inputs,
+                           streaming)
+                new_root, n = rule(root, ctx)
+                if verify_rules and new_root is not root:
+                    # post-optimize assertion, per rule: every rule's
+                    # output must re-validate — the first invalid rewrite
+                    # names itself instead of hiding behind the
+                    # end-of-pipeline net
+                    bad = _plan_error(new_root, bound)
+                    if bad is not None:
+                        report.passes = p + 1
+                        report.fallback = _diagnose(name, bad)
+                        return _fall_back(plan, report)
+                root = new_root
+                report.rules[name] += n
+                pass_hits += n
+            report.passes = p + 1
+            if not pass_hits:
+                break
+        if mesh_peers is not None and mesh_peers > 1:
             ctx = _Ctx(root, bound, bound_rows, report, float_inputs,
                        streaming)
-            root, n = rule(root, ctx)
-            report.rules[name] += n
-            pass_hits += n
-        report.passes = p + 1
-        if not pass_hits:
-            break
-    if mesh_peers is not None and mesh_peers > 1:
-        ctx = _Ctx(root, bound, bound_rows, report, float_inputs, streaming)
-        root, n = _plan_exchanges(root, ctx, mesh_peers)
-        report.rules["exchange_planning"] += n
+            new_root, n = _plan_exchanges(root, ctx, mesh_peers)
+            if verify_rules and new_root is not root:
+                bad = _plan_error(new_root, bound)
+                if bad is not None:
+                    report.fallback = _diagnose("exchange_planning", bad)
+                    return _fall_back(plan, report)
+            root = new_root
+            report.rules["exchange_planning"] += n
+    except PlanValidationError as err:
+        # an invalid mid-pipeline rewrite can detonate inside a LATER
+        # rule's schema resolution (not just at the end-of-pipeline
+        # re-validation) — that too is a fall-back, not a query failure,
+        # and _attribute_fallback re-runs rule-by-rule to name the
+        # culprit rather than the victim
+        report.fallback = _attribute_fallback(
+            plan, bound, bound_rows, float_inputs, streaming, mesh_peers,
+            err)
+        return _fall_back(plan, report)
     if root is plan.root:
         report.fingerprint = report.source_fingerprint
         return plan, report
     try:
         opt = Plan(root)
-    except PlanValidationError:
+        if bound:
+            # declared schemas alone under-validate scans bound only at
+            # execute(); the fall-back net must catch what execution would
+            opt.resolve_schemas(bound)
+    except PlanValidationError as err:
         # defensive: a rewrite produced an invalid DAG — run the authored
-        # plan rather than failing the query. The report must describe
-        # what RAN, so the discarded rewrite's counts are zeroed: a
-        # parity gate reading rules_fired/pruned_columns would otherwise
-        # celebrate rewrites that never executed
-        report.fell_back = True
-        report.rules = {name: 0 for name in RULE_NAMES}
-        report.pruned_columns = 0
-        report.pruned_bytes_est = 0
-        report.exchanges = {}
-        report.exchanges_elided = 0
-        report.sharding = {}
-        report.fingerprint = report.source_fingerprint
-        return plan, report
+        # plan rather than failing the query, with the culprit rule/node/
+        # invariant attributed post-hoc (analysis/verifier.py vocabulary)
+        report.fallback = _attribute_fallback(
+            plan, bound, bound_rows, float_inputs, streaming, mesh_peers,
+            err)
+        return _fall_back(plan, report)
     report.fingerprint = opt.fingerprint
     return opt, report
 

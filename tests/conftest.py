@@ -15,6 +15,12 @@ TPU_SMOKE = os.environ.get("SRT_TPU_SMOKE", "") == "1"
 
 if not TPU_SMOKE:
     os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Static plan verifier gate (analysis/verifier.py, docs/analysis.md): ON
+# for the whole suite — every plan any test executes is symbolically
+# verified pre-execution, and every optimizer rule's output re-validates.
+# setdefault so a test (or developer) can still export =0 to bisect.
+os.environ.setdefault("SPARK_RAPIDS_TPU_VERIFY_PLANS", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags and not TPU_SMOKE:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

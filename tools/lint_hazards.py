@@ -1,0 +1,483 @@
+#!/usr/bin/env python
+"""AST-based JAX-hazard linter for the engine codebase (docs/analysis.md).
+
+Every rule here is a bug class a past review actually caught by hand —
+this makes the catch permanent and premerge-enforced (ci/premerge.sh):
+
+- ``jit-self-capture``: `self` referenced inside a function traced by
+  `jax.jit`/`pjit`/`shard_map`. A jitted callable closes over everything
+  it references; cached process-globally (the distributed tier's
+  jitted-primitive cache) a bound `self` pins the executor — and its
+  plan/LRU graph — long after the session died (the PR 5 review finding).
+- ``host-sync-in-jit``: `np.asarray`/`np.array`/`jax.device_get`/
+  `.item()`/`float()`/`int()`/`bool()` on values inside a traced
+  function — a host round-trip per call on the hot path (or a trace
+  error). Shape/static lookups (`x.shape[0]`, `len(...)`) are exempt.
+- ``tracer-branch``: Python `if`/`while` on an expression derived from a
+  traced function's parameters — tracers have no truth value; the branch
+  either crashes or silently bakes in one trace-time path.
+- ``env-outside-config``: `os.environ`/`os.getenv` anywhere but
+  `config.py`. Knobs are read-at-use through config.py so tests can
+  monkeypatch and the optimizer can key its caches on them
+  (the SPARK_RAPIDS_TPU_BROADCAST_ROWS cache-key fix came from review).
+- ``fingerprint-iteration``: unsorted `.items()`/`.keys()`/`.values()`
+  or `set()`/`frozenset()` iteration inside fingerprint-computing
+  functions — nondeterministic order feeding a structural hash silently
+  splits the compiled-program cache (or worse, collides).
+
+Vetted exceptions live in the allowlist (default
+``tools/lint_hazards_allowlist.txt``), one per line::
+
+    <repo/relative/path.py>::<rule>::<qualified.context>  # justification
+
+The justification is REQUIRED — an allowlist entry without a reason
+fails the run. Usage::
+
+    python tools/lint_hazards.py [paths...] [--allowlist FILE] [--list]
+
+Exit status 1 when any unsuppressed finding remains.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import os
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+_JIT_NAMES = {"jit", "pjit", "shard_map"}
+_HOST_SYNC_DOTTED = {"np.asarray", "np.array", "numpy.asarray",
+                     "numpy.array", "jax.device_get", "onp.asarray"}
+_CASTS = {"float", "int", "bool"}
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "names", "num_rows",
+                 "itemsize", "nbytes"}
+_STATIC_CALLS = {"isinstance", "len", "getattr", "hasattr", "callable",
+                 "type", "range", "enumerate", "zip"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str            # repo-relative posix path
+    line: int
+    context: str         # dotted qualname of the enclosing def/class
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.path, self.rule, self.context)
+
+    def __str__(self):
+        return (f"{self.path}:{self.line}: [{self.rule}] "
+                f"{self.context or '<module>'}: {self.message}")
+
+
+def _scope_walk(stmt):
+    """ast.walk that stays in the current lexical scope: descends into
+    everything EXCEPT nested def/class bodies (each is linted as its own
+    scope — descending would double-report their findings under every
+    enclosing qualname). Lambdas count as same-scope: they cannot contain
+    statements, and jit-wrapped lambdas nested in builder lambdas are
+    this scope's business."""
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+def _dotted(node) -> str:
+    """'jax.jit' for Attribute/Name chains; '' for anything else."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_callable(func) -> bool:
+    d = _dotted(func)
+    return bool(d) and d.split(".")[-1] in _JIT_NAMES
+
+
+def _is_jit_decorator(dec) -> bool:
+    if _is_jit_callable(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if _is_jit_callable(dec.func):
+            return True
+        if _dotted(dec.func).split(".")[-1] == "partial":
+            return any(_is_jit_callable(a) for a in dec.args)
+    return False
+
+
+def _func_params(fn) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in
+             a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def _static_params(jit_call: Optional[ast.Call], fn) -> Set[str]:
+    """Parameter names `static_argnames`/`static_argnums` pin at trace
+    time — python control flow on THOSE is legitimate specialization,
+    not a tracer branch."""
+    if jit_call is None:
+        return set()
+    out: Set[str] = set()
+    a = getattr(fn, "args", None)
+    positional = ([p.arg for p in a.posonlyargs + a.args]
+                  if a is not None else [])
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnames":
+            vals = (kw.value.elts
+                    if isinstance(kw.value, (ast.Tuple, ast.List))
+                    else [kw.value])
+            out.update(v.value for v in vals
+                       if isinstance(v, ast.Constant)
+                       and isinstance(v.value, str))
+        elif kw.arg == "static_argnums":
+            vals = (kw.value.elts
+                    if isinstance(kw.value, (ast.Tuple, ast.List))
+                    else [kw.value])
+            for v in vals:
+                if isinstance(v, ast.Constant) and \
+                        isinstance(v.value, int) and \
+                        v.value < len(positional):
+                    out.add(positional[v.value])
+    return out
+
+
+def _refs_param_value(node, params: Set[str]) -> bool:
+    """Whether the expression branches on a parameter's VALUE (a tracer),
+    as opposed to static metadata (shapes, dtypes, isinstance, is None)."""
+    if isinstance(node, ast.Name):
+        return node.id in params
+    if isinstance(node, ast.Attribute):
+        if node.attr in _STATIC_ATTRS:
+            return False
+        return _refs_param_value(node.value, params)
+    if isinstance(node, ast.Subscript):
+        return _refs_param_value(node.value, params)
+    if isinstance(node, ast.Call):
+        if _dotted(node.func).split(".")[-1] in _STATIC_CALLS:
+            return False
+        return any(_refs_param_value(a, params)
+                   for a in list(node.args) + [k.value
+                                               for k in node.keywords])
+    if isinstance(node, ast.Compare):
+        # `x is None` / `x is not None` is a host-side identity check
+        if all(isinstance(c, (ast.Constant,)) and c.value is None
+               for c in node.comparators) and \
+                all(isinstance(o, (ast.Is, ast.IsNot)) for o in node.ops):
+            return False
+    return any(_refs_param_value(c, params)
+               for c in ast.iter_child_nodes(node))
+
+
+class _ModuleLinter:
+    def __init__(self, path: str, rel: str, tree: ast.Module):
+        self.rel = rel
+        self.tree = tree
+        self.findings: List[Finding] = []
+        self.is_config = os.path.basename(path) == "config.py"
+
+    # ---- entry ------------------------------------------------------------
+    def run(self) -> List[Finding]:
+        self._scan_scope(self.tree.body, [])
+        self._scan_env(self.tree)
+        return self.findings
+
+    def _add(self, rule: str, node, qual: List[str], msg: str):
+        self.findings.append(Finding(rule, self.rel,
+                                     getattr(node, "lineno", 0),
+                                     ".".join(qual), msg))
+
+    # ---- traced-function discovery ----------------------------------------
+    def _scan_scope(self, body, qual: List[str]):
+        """One lexical scope: find functions traced by jit/shard_map (as
+        direct lambda/def arguments, decorated defs, or local defs passed
+        by name) and lint their bodies; recurse into nested scopes."""
+        local_defs: Dict[str, ast.AST] = {}
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_defs[stmt.name] = stmt
+        traced: List[Tuple[ast.AST, List[str], Optional[ast.Call]]] = []
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue          # its own scope; recursed below
+            for node in _scope_walk(stmt):
+                if isinstance(node, ast.Call) and \
+                        _is_jit_callable(node.func):
+                    for arg in node.args[:1]:
+                        if isinstance(arg, ast.Lambda):
+                            traced.append((arg, qual + ["<lambda>"], node))
+                        elif isinstance(arg, ast.Name) and \
+                                arg.id in local_defs:
+                            fn = local_defs[arg.id]
+                            traced.append((fn, qual + [fn.name], node))
+                        elif any(isinstance(n, ast.Name) and
+                                 n.id == "self"
+                                 for n in ast.walk(arg)):
+                            # jax.jit(self._prim) / jax.jit(partial(
+                            # self._prim, ...)): jitting a bound method
+                            # IS the capture — no lambda body to lint,
+                            # the callable itself pins the instance
+                            self._add(
+                                "jit-self-capture", arg, qual,
+                                "bound method (or partial over `self`) "
+                                "passed to jit/shard_map — the compiled "
+                                "callable pins the instance for the "
+                                "cache's lifetime; hoist the needed "
+                                "state into locals and trace a free "
+                                "function")
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for d in stmt.decorator_list:
+                    if _is_jit_decorator(d):
+                        traced.append((stmt, qual + [stmt.name],
+                                       d if isinstance(d, ast.Call)
+                                       else None))
+                        break
+        seen = set()
+        for fn, fq, jit_call in traced:
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            self._lint_traced(fn, fq, _static_params(jit_call, fn))
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_scope(stmt.body, qual + [stmt.name])
+            elif isinstance(stmt, ast.ClassDef):
+                self._scan_scope(stmt.body, qual + [stmt.name])
+
+    # ---- rules over one traced function ------------------------------------
+    def _lint_traced(self, fn, qual: List[str],
+                     static: Optional[Set[str]] = None):
+        params = _func_params(fn) - (static or set())
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for node in [n for stmt in body for n in ast.walk(stmt)]:
+            if isinstance(node, ast.Name) and node.id == "self" \
+                    and "self" not in params:
+                self._add("jit-self-capture", node, qual,
+                          "`self` captured inside a jit/shard_map-traced "
+                          "function — the compiled callable pins the "
+                          "instance (and everything it references) for "
+                          "the cache's lifetime; close over locals "
+                          "instead")
+            elif isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d in _HOST_SYNC_DOTTED:
+                    self._add("host-sync-in-jit", node, qual,
+                              f"{d}() on a traced value forces a "
+                              "device->host sync (or a trace error) "
+                              "inside the compiled hot path")
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "item" and not node.args:
+                    self._add("host-sync-in-jit", node, qual,
+                              ".item() on a traced value forces a "
+                              "device->host sync inside the compiled "
+                              "hot path")
+                elif isinstance(node.func, ast.Name) and \
+                        node.func.id in _CASTS and len(node.args) == 1 \
+                        and _refs_param_value(node.args[0], params):
+                    self._add("host-sync-in-jit", node, qual,
+                              f"{node.func.id}() of a traced value "
+                              "forces a device->host sync; compute with "
+                              "jnp and keep it on device")
+            elif isinstance(node, (ast.If, ast.While)):
+                if _refs_param_value(node.test, params):
+                    self._add("tracer-branch", node, qual,
+                              "python control flow on a traced "
+                              "expression — tracers have no truth "
+                              "value; use jnp.where/lax.cond or hoist "
+                              "the decision out of the trace")
+
+    # ---- module-wide rules -------------------------------------------------
+    def _scan_env(self, tree: ast.Module):
+        fingerprints: List[Tuple[ast.AST, List[str]]] = []
+
+        def walk(body, qual):
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef)):
+                    fq = qual + [stmt.name]
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) and (
+                            "fingerprint" in stmt.name
+                            or stmt.name.startswith("_fp")):
+                        fingerprints.append((stmt, fq))
+                    walk(stmt.body, fq)
+                    continue
+                if not self.is_config:
+                    for node in ast.walk(stmt):
+                        # `from os import environ/getenv` aliases the
+                        # read past the dotted-form check below — flag
+                        # the import itself
+                        if isinstance(node, ast.ImportFrom) and \
+                                node.module == "os":
+                            for alias in node.names:
+                                if alias.name in ("environ", "getenv"):
+                                    self._add(
+                                        "env-outside-config", node, qual,
+                                        f"from os import {alias.name} "
+                                        "outside config.py breaks the "
+                                        "read-at-use knob contract "
+                                        "(tests monkeypatch config.py; "
+                                        "caches key on its getters)")
+                            continue
+                        # match the `os.environ`/`os.getenv` Attribute
+                        # itself (never the wrapping Call/Subscript —
+                        # matching both would report every use twice)
+                        if not isinstance(node, ast.Attribute):
+                            continue
+                        d = _dotted(node)
+                        if d in ("os.environ", "os.getenv"):
+                            self._add(
+                                "env-outside-config", node, qual,
+                                f"{d} outside config.py breaks the "
+                                "read-at-use knob contract (tests "
+                                "monkeypatch config.py; caches key on "
+                                "its getters)")
+
+        walk(tree.body, [])
+        for fn, fq in fingerprints:
+            self._lint_fingerprint(fn, fq)
+
+    def _lint_fingerprint(self, fn, qual: List[str]):
+        sanctioned: Set[int] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    _dotted(node.func) == "sorted":
+                for a in ast.walk(node):
+                    sanctioned.add(id(a))
+        for node in ast.walk(fn):
+            if id(node) in sanctioned:
+                continue
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("items", "keys", "values"):
+                self._add("fingerprint-iteration", node, qual,
+                          f".{node.func.attr}() iterated unsorted "
+                          "inside a fingerprint computation — dict "
+                          "order is insertion order, which is not "
+                          "canonical across equivalent plans; wrap in "
+                          "sorted()")
+            elif isinstance(node, ast.For) and \
+                    isinstance(node.iter, ast.Call) and \
+                    _dotted(node.iter.func) in ("set", "frozenset"):
+                self._add("fingerprint-iteration", node, qual,
+                          "iterating a set inside a fingerprint "
+                          "computation — set order is nondeterministic "
+                          "across processes; sort first")
+
+
+# ---- allowlist --------------------------------------------------------------
+
+def load_allowlist(path: str) -> Dict[Tuple[str, str, str], str]:
+    """{(path, rule, context): justification}. Every entry REQUIRES a
+    non-empty `# justification`; a bare suppression fails the run."""
+    out: Dict[Tuple[str, str, str], str] = {}
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            entry, _, just = line.partition("#")
+            just = just.strip()
+            fields = [p.strip() for p in entry.strip().split("::")]
+            if len(fields) != 3 or not all(fields):
+                raise SystemExit(
+                    f"{path}:{lineno}: malformed allowlist entry "
+                    f"(want path::rule::context  # justification)")
+            if not just:
+                raise SystemExit(
+                    f"{path}:{lineno}: allowlist entry for "
+                    f"{fields[0]} has no justification — every vetted "
+                    "exception must say why")
+            out[tuple(fields)] = just
+    return out
+
+
+# ---- driver -----------------------------------------------------------------
+
+def lint_paths(paths: List[str], repo_root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    files: List[str] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            files.append(p)
+        else:
+            for dirpath, _, names in os.walk(p):
+                files.extend(os.path.join(dirpath, n)
+                             for n in sorted(names) if n.endswith(".py"))
+    for path in sorted(files):
+        rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
+        with open(path, "rb") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            findings.append(Finding("parse-error", rel, e.lineno or 0,
+                                    "", str(e)))
+            continue
+        findings.extend(_ModuleLinter(path, rel, tree).run())
+    return findings
+
+
+def main(argv=None) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ap = argparse.ArgumentParser(
+        description="JAX-hazard linter (docs/analysis.md)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: spark_rapids_tpu)")
+    ap.add_argument("--allowlist",
+                    default=os.path.join(repo_root, "tools",
+                                         "lint_hazards_allowlist.txt"))
+    ap.add_argument("--list", action="store_true",
+                    help="print every finding, including allowlisted")
+    args = ap.parse_args(argv)
+    paths = args.paths or [os.path.join(repo_root, "spark_rapids_tpu")]
+    allow = load_allowlist(args.allowlist)
+    findings = lint_paths(paths, repo_root)
+    used: Set[Tuple[str, str, str]] = set()
+    open_findings: List[Finding] = []
+    for f in findings:
+        if f.key() in allow:
+            used.add(f.key())
+            if args.list:
+                print(f"ALLOWED {f}  # {allow[f.key()]}")
+        else:
+            open_findings.append(f)
+    for f in open_findings:
+        print(f)
+    stale = set(allow) - used
+    for key in sorted(stale):
+        print(f"NOTE: stale allowlist entry (no longer matches): "
+              f"{'::'.join(key)}")
+    if open_findings:
+        print(f"lint_hazards: {len(open_findings)} finding(s) "
+              f"({len(used)} allowlisted)")
+        return 1
+    print(f"lint_hazards: clean ({len(used)} vetted exception(s), "
+          f"{len(findings)} raw finding(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
