@@ -115,12 +115,21 @@ def measure(force_cpu: bool) -> None:
         except Exception:
             vs_baseline = None  # baseline did not run; distinct from 1.0
 
+    # kernel-registry stamp (docs/kernels.md): this bench times the jnp
+    # fused-XLA row hash — the universal lowering, registry-free on every
+    # backend — so the honest per-run stamp is "fallback" everywhere,
+    # stated explicitly on the CPU-fallback path and the device path alike
+    # (stamping the registry's would-be summary here would attribute
+    # kernels this run never dispatched)
+    kernels = "fallback"
+
     print(json.dumps({
         "metric": "spark_row_hash_throughput",
         "value": round(dev_rows_per_s / 1e6, 3),
         "unit": UNIT,
         "vs_baseline": vs_baseline,
         "backend": dev.platform,
+        "kernels": kernels,
     }))
 
 

@@ -221,11 +221,20 @@ def main(argv=None):
     # on the JSONL rows (docs/optimizer.md)
     from benchmarks.nds_plans import (dist_mesh, q5_inputs, q5_plan,
                                       run_plan_distributed,
+                                      run_plan_kernels,
                                       run_plan_variants)
     run_plan_variants("nds_q5_pipeline_plan", {"num_rows": n_total},
                       q5_plan(), q5_inputs(tabs, dates),
                       n_rows=n_total, iters=args.iters,
                       caps=dict(key_cap=2048))
+
+    # kernel-registry variant (docs/kernels.md): registry-on vs forced-
+    # fallback, parity asserted — the named config ci/nightly.sh's
+    # kernel_bench speedup gate reads
+    run_plan_kernels("nds_q5_pipeline_kernels", {"num_rows": n_total},
+                     q5_plan(), q5_inputs(tabs, dates),
+                     n_rows=n_total, iters=args.iters,
+                     caps=dict(key_cap=2048))
 
     # distributed tier (docs/distributed.md): the same plan SPMD over a
     # simulated mesh, parity-gated against the single-device eager run

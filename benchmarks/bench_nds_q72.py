@@ -208,12 +208,22 @@ def main(argv=None):
     # on the JSONL rows (docs/optimizer.md)
     from benchmarks.nds_plans import (dist_mesh, q72_inputs, q72_plan,
                                       run_plan_distributed,
+                                      run_plan_kernels,
                                       run_plan_variants)
     run_plan_variants("nds_q72_pipeline_plan", {"num_sales": n},
                       q72_plan(), q72_inputs(*tabs),
                       n_rows=n, iters=args.iters,
                       caps=dict(row_cap=caps["row_cap"],
                                 key_cap=caps["key_cap"]))
+
+    # kernel-registry variant (docs/kernels.md): registry-on vs forced-
+    # fallback, parity asserted — the named config ci/nightly.sh's
+    # kernel_bench speedup gate reads
+    run_plan_kernels("nds_q72_pipeline_kernels", {"num_sales": n},
+                     q72_plan(), q72_inputs(*tabs),
+                     n_rows=n, iters=args.iters,
+                     caps=dict(row_cap=caps["row_cap"],
+                               key_cap=caps["key_cap"]))
 
     # distributed tier (docs/distributed.md): the same plan SPMD over a
     # simulated mesh, parity-gated against the single-device eager run

@@ -118,6 +118,7 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
                 io_overlap_ms: float = None,
                 mesh_axis: str = None,
                 exchange_bytes: int = None,
+                kernels=None,
                 **extra) -> Dict:
     """Build + print one bench JSONL record.
 
@@ -152,7 +153,15 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
     docs/io.md): `io_row_groups_pruned` (groups skipped via footer
     min/max stats), `io_bytes_skipped` (compressed chunk bytes never
     decoded), `io_overlap_ms` (host decode that ran concurrently with
-    execution — the prefetch pipeline's measured win)."""
+    execution — the prefetch pipeline's measured win).
+
+    Optional kernel-registry field (benchmarks/kernel_bench.py, the
+    `*_kernels` plan variants; docs/kernels.md): `kernels` — the per-op
+    kernel choices the measured run actually dispatched (a dict like
+    {"hash_join": "pallas", ...} from OperatorMetrics.kernel, or the
+    string "fallback" when every op ran its universal lowering).
+    Trajectory numbers must never silently compare kernel backends —
+    the same rule as the `backend` stamp."""
     rec = {"bench": bench, "axes": axes, "ms": round(ms, 3),
            "rows_per_s": round(n_rows / (ms * 1e-3)),
            "backend": jax.default_backend(),
@@ -179,6 +188,8 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
         rec["io_bytes_skipped"] = io_bytes_skipped
     if io_overlap_ms is not None:
         rec["io_overlap_ms"] = round(io_overlap_ms, 3)
+    if kernels is not None:
+        rec["kernels"] = kernels
     rec.update(extra)
     print(json.dumps(rec), flush=True)
     return rec

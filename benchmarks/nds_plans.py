@@ -219,6 +219,70 @@ def run_plan_variants(bench: str, axes: dict, plan, inputs, *,
     return recs
 
 
+# ---- kernel-registry (*_kernels) variants -----------------------------------
+
+def kernels_of(res) -> dict:
+    """op -> kernel name(s) an executed plan actually dispatched, from the
+    per-op OperatorMetrics.kernel stamps (docs/kernels.md). Multiple nodes
+    of one op kind may resolve differently (signature declines), so values
+    are comma-joined sorted sets."""
+    chosen = {}
+    for m in res.metrics.values():
+        if m.kernel:
+            name, _, op = m.kernel.partition(":")
+            chosen.setdefault(op, set()).add(name)
+    return {op: ",".join(sorted(names))
+            for op, names in sorted(chosen.items())}
+
+
+def run_plan_kernels(bench: str, axes: dict, plan, inputs, *,
+                     n_rows: int, iters: int, caps: dict = None):
+    """Time the capped plan tier with the kernel registry LIVE and with
+    every op forced to its universal fallback
+    (SPARK_RAPIDS_TPU_KERNELS=op=fallback,...), assert EXACT result parity
+    between the two, and stamp the per-op kernel choices / the "fallback"
+    marker on the JSONL rows. These are the named configs behind
+    ci/nightly.sh's kernel_bench stage and its capped-tier speedup gate
+    (docs/kernels.md). Returns [registry-on record, forced-fallback
+    record]."""
+    import os
+    from spark_rapids_tpu.plan import PlanExecutor
+    from spark_rapids_tpu.ops.registry import REGISTRY
+    from benchmarks.common import run_config
+
+    fallback_spec = ",".join(
+        f"{op}={next(k.name for k in REGISTRY.kernels(op) if k.fallback)}"
+        for op in REGISTRY.ops())
+    prev = os.environ.get("SPARK_RAPIDS_TPU_KERNELS")
+    results, recs = {}, []
+    try:
+        for label, spec in (("on", prev), ("fallback", fallback_spec)):
+            if spec is None:
+                os.environ.pop("SPARK_RAPIDS_TPU_KERNELS", None)
+            else:
+                os.environ["SPARK_RAPIDS_TPU_KERNELS"] = spec
+            ex = PlanExecutor(mode="capped", caps=dict(caps or {}))
+            res = ex.execute(plan, inputs)      # correctness + stamps run
+            results[label] = res.compact().to_pydict()
+            kern = kernels_of(res) if label == "on" else "fallback"
+
+            def prun():
+                r = ex.execute(plan, inputs)
+                return [c.data for c in r.table.columns], r.valid
+
+            recs.append(run_config(
+                bench, dict(axes), prun, (), n_rows=n_rows, iters=iters,
+                jit=False, impl="plan_capped", kernels=kern))
+    finally:
+        if prev is None:
+            os.environ.pop("SPARK_RAPIDS_TPU_KERNELS", None)
+        else:
+            os.environ["SPARK_RAPIDS_TPU_KERNELS"] = prev
+    assert results["on"] == results["fallback"], \
+        f"{bench}: kernel selection changed the result"
+    return recs
+
+
 # ---- distributed (*_dist) variants ------------------------------------------
 
 def dist_mesh(n_devices: int = 4, axis: str = "data"):

@@ -41,6 +41,14 @@ JAX_PLATFORMS=cpu python benchmarks/streaming_scan.py --scale 0.5 --cpu
 # fields
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python benchmarks/distributed_parity.py --scale 0.2 --cpu
+# kernel-registry gate (docs/kernels.md): per-kernel parity (each Pallas
+# kernel FORCED against its XLA fallback — interpret mode on CPU) plus the
+# NDS q5/q72 capped tier registry-on vs forced-fallback with exact parity;
+# on this CPU runner it additionally asserts auto-selection picked no
+# accelerator kernel, and the capped-tier speedup gate arms itself
+# whenever a TPU backend is present; emits per-kernel JSONL rows with the
+# `kernels` stamp
+JAX_PLATFORMS=cpu python benchmarks/kernel_bench.py --scale 0.05 --cpu
 # deep plan fuzz (docs/analysis.md): a seeded sweep of >=200 random plans
 # over all 11 operator kinds — static verification (authored + optimized,
 # per-rule re-validation), no optimizer fall-backs, and small-plan eager

@@ -12,8 +12,9 @@ equivalents, all read at use time (not import time) so tests can monkeypatch:
 | SPARK_RAPIDS_TPU_RETRY_LIMIT     | 500  | livelock cap before hard OOM   |
 | SPARK_RAPIDS_TPU_TRACE           | 0    | profiler ranges (utils/tracing)|
 | TPU_FAULT_INJECTOR_CONFIG_PATH   | —    | fault injector config (faultinj)|
-| SPARK_RAPIDS_TPU_ROW_CONVERSION_KERNEL | auto | auto/word/concat (ops/row_conversion) |
-| SPARK_RAPIDS_TPU_GROUPBY_KERNEL  | auto | auto/scan/scatter (ops/aggregate) |
+| SPARK_RAPIDS_TPU_KERNELS         | —    | kernel-registry overrides, `op=name` pairs (e.g. `fused_select=xla,topk=pallas,groupby=scan`; ops/registry.py, docs/kernels.md) |
+| SPARK_RAPIDS_TPU_ROW_CONVERSION_KERNEL | auto | auto/word/concat (legacy alias for `row_conversion=` in SPARK_RAPIDS_TPU_KERNELS) |
+| SPARK_RAPIDS_TPU_GROUPBY_KERNEL  | auto | auto/scan/scatter (legacy alias for `groupby=` in SPARK_RAPIDS_TPU_KERNELS) |
 | SPARK_RAPIDS_TPU_BREAKER_RETRY_BUDGET | 16 | fault retries allowed per plan attempt (runtime/health) |
 | SPARK_RAPIDS_TPU_BREAKER_BACKOFF_BASE_MS | 10 | first-retry backoff (doubles per attempt, jittered) |
 | SPARK_RAPIDS_TPU_BREAKER_BACKOFF_MAX_MS | 1000 | backoff ceiling |
@@ -197,6 +198,40 @@ def faultinj_config_path() -> str:
     hazard linter's env-reads-outside-config rule holds for faultinj.py
     too; empty string when unset."""
     return os.environ.get("TPU_FAULT_INJECTOR_CONFIG_PATH", "")
+
+
+def kernel_overrides() -> dict:
+    """Kernel-registry overrides (ops/registry.py, docs/kernels.md): the ONE
+    backend-dispatch knob. Comma-separated `op=kernel` pairs, e.g.
+    `SPARK_RAPIDS_TPU_KERNELS=fused_select=xla,topk=pallas,groupby=scan`.
+    The legacy per-op vars (SPARK_RAPIDS_TPU_GROUPBY_KERNEL,
+    SPARK_RAPIDS_TPU_ROW_CONVERSION_KERNEL) fold in as aliases for the
+    `groupby`/`row_conversion` entries; an explicit SPARK_RAPIDS_TPU_KERNELS
+    entry wins over its alias. Format errors raise here; unknown op/kernel
+    NAMES raise in the registry, which owns the catalog — both directions of
+    the strict-typo policy (a typo must not silently change which kernel an
+    A/B capture measured). Signature-level declines are NOT errors: a forced
+    kernel that cannot run a given signature falls back cleanly."""
+    out = {}
+    g = groupby_kernel()
+    if g != "auto":
+        out["groupby"] = g
+    r = row_conversion_kernel()
+    if r != "auto":
+        out["row_conversion"] = r
+    spec = os.environ.get("SPARK_RAPIDS_TPU_KERNELS", "")
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        op, sep, name = part.partition("=")
+        op, name = op.strip(), name.strip()
+        if not sep or not op or not name:
+            raise ValueError(
+                f"SPARK_RAPIDS_TPU_KERNELS: malformed entry {part!r} "
+                "(expected op=kernel, e.g. fused_select=xla)")
+        out[op] = name
+    return out
 
 
 def groupby_kernel() -> str:

@@ -396,14 +396,13 @@ def _use_scan_kernel() -> bool:
     """Backend dispatch for the groupby kernel (see _groupby_kernel vs
     _groupby_kernel_scatter — the scan design wins on TPU where scatters
     are ~25x a cumsum, the segment/scatter design wins ~2x on CPU).
-    Override: SPARK_RAPIDS_TPU_GROUPBY_KERNEL=scan|scatter."""
-    from ..config import groupby_kernel
-    mode = groupby_kernel()
-    if mode == "scan":
-        return True
-    if mode == "scatter":
-        return False
-    return jax.default_backend() != "cpu"
+    Selection lives in the kernel registry (ops/registry.py,
+    docs/kernels.md): "scan" is the universal fallback, "scatter"
+    registers for the cpu backend. Override:
+    SPARK_RAPIDS_TPU_KERNELS=groupby=scan|scatter (legacy
+    SPARK_RAPIDS_TPU_GROUPBY_KERNEL honored as an alias)."""
+    from .registry import REGISTRY
+    return REGISTRY.select("groupby").name == "scan"
 
 
 def groupby_aggregate(table: Table,
@@ -598,3 +597,14 @@ def groupby_aggregate_capped(table: Table,
     (SplitAndRetry contract)."""
     return groupby_aggregate(table, key_names, aggs, _cap=key_cap,
                              _alive=alive)
+
+
+# ---- kernel-registry wiring (ops/registry.py, docs/kernels.md) --------------
+# the scan design is the universal lowering (TPU-first: scatters are ~25x a
+# cumsum there); the scatter/segment design registers for the cpu backend,
+# where it measured ~2x the scan design (tools/ab_relational.jsonl)
+from .registry import REGISTRY as _REGISTRY  # noqa: E402
+
+_REGISTRY.register("groupby", "scan", fn=_groupby_kernel, fallback=True)
+_REGISTRY.register("groupby", "scatter", fn=_groupby_kernel_scatter,
+                   backends=("cpu",))

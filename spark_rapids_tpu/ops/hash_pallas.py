@@ -31,7 +31,10 @@ planes to materialize in HBM (Mosaic cannot de-interleave the raw little-
 endian i64 pairs in-register: strided lane slices and minor-dim reshapes are
 unsupported). Kept as the explicit-kernel path — it documents the layout and
 wins when the planes are already split (e.g. reused across several hash
-calls); the jnp path stays the default.
+calls); the jnp path stays the default. ops/join_pallas.py is exactly that
+reuse case: its hash-join build/probe kernels consume this module's word
+planes (and round/fmix chain) in-kernel, with selection owned by the
+kernel registry (ops/registry.py, docs/kernels.md).
 """
 from __future__ import annotations
 
@@ -205,9 +208,21 @@ def _planes(col: Column, normalize_zero: bool):
     raise TypeError(f"pallas row hash: unsupported dtype {col.dtype}")
 
 
-def _to_tiles(x, n_pad):
-    x = jnp.pad(x, (0, n_pad - x.shape[0]))
-    return x.reshape(n_pad // _LANES, _LANES)
+def _to_tiles(x, n_pad, lanes: int = _LANES, fill=0):
+    """Pad a flat (n,) array to n_pad rows and tile it (n_pad/lanes,
+    lanes) — the one word-plane layout transform shared by every Pallas
+    module here (join_pallas, topk_pallas, select_pallas); `fill` is the
+    padding value (topk pads with its sentinel)."""
+    x = jnp.pad(x, (0, n_pad - x.shape[0]), constant_values=fill)
+    return x.reshape(n_pad // lanes, lanes)
+
+
+def _u16_halves(w) -> Tuple:
+    """u32 word -> (lo16, hi16) as f32 — the split that keeps one-hot MXU
+    gathers bit-exact (a single <=16-bit term per product fits the f32
+    mantissa). Shared by the join/select compaction kernels."""
+    return ((w & _u32c(0xFFFF)).astype(jnp.float32),
+            (w >> _U32(16)).astype(jnp.float32))
 
 
 def _pack_inputs(cols: Sequence[Column], normalize_zero: bool, n: int,

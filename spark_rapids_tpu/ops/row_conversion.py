@@ -82,14 +82,13 @@ def _use_word_kernel() -> bool:
     exist for TPU tiling (narrow u8 slices pad to (32, 128) tiles; measured
     CPU A/B in BENCH_DETAIL.md round-5: the word kernel is ~1.4x SLOWER on
     CPU where the concat lowers to clean memcpys, so CPU keeps the byte
-    kernels). Override: SPARK_RAPIDS_TPU_ROW_CONVERSION_KERNEL=word|concat."""
-    from ..config import row_conversion_kernel
-    mode = row_conversion_kernel()
-    if mode == "word":
-        return True
-    if mode == "concat":
-        return False
-    return jax.default_backend() != "cpu"
+    kernels). Selection lives in the kernel registry (ops/registry.py,
+    docs/kernels.md): "word" is the universal fallback, "concat" registers
+    for the cpu backend. Override:
+    SPARK_RAPIDS_TPU_KERNELS=row_conversion=word|concat (legacy
+    SPARK_RAPIDS_TPU_ROW_CONVERSION_KERNEL honored as an alias)."""
+    from .registry import REGISTRY
+    return REGISTRY.select("row_conversion").name == "word"
 
 
 def _word_plan(dts: Sequence[dtypes.DType]):
@@ -423,3 +422,14 @@ def convert_from_rows(rows_col: Column, schema: Sequence[dtypes.DType]) -> Table
     for dt, data, mask in zip(schema, datas, masks):
         cols.append(Column(dtype=dt, length=n, data=data, validity=mask))
     return Table(cols)
+
+
+# ---- kernel-registry wiring (ops/registry.py, docs/kernels.md) --------------
+# the u32 word kernels are the universal lowering (TPU tiling: narrow u8
+# slices pad to (32, 128) tiles); the byte-concat kernels register for the
+# cpu backend, where the word kernel measured ~1.4x slower (BENCH_DETAIL.md
+# round-5)
+from .registry import REGISTRY as _REGISTRY  # noqa: E402
+
+_REGISTRY.register("row_conversion", "word", fallback=True)
+_REGISTRY.register("row_conversion", "concat", backends=("cpu",))

@@ -587,9 +587,22 @@ class PlanExecutor:
             opt, _, report = self._optimized(plan, inputs, bound)
             return "\n".join(["== authored ==", plan.explain(), "",
                               "== optimized ==", opt.explain(), "",
-                              report.summary()])
+                              report.summary(), self._kernel_summary()])
         from .optimizer import explain_optimized
-        return explain_optimized(plan)
+        return explain_optimized(plan) + "\n" + self._kernel_summary()
+
+    @staticmethod
+    def _kernel_summary() -> str:
+        """One registry line for explain(optimized=True): the signature-
+        independent per-op choice on the current backend (docs/kernels.md).
+        Signature-conditional kernels (the Pallas set) resolve per dispatch
+        and show up on OperatorMetrics.kernel / profile_text post-run."""
+        from ..ops.registry import REGISTRY
+        pairs = ", ".join(f"{op}={name}"
+                          for op, name in sorted(REGISTRY.summary().items()))
+        return (f"kernels [{jax.default_backend()}]: {pairs} "
+                "(signature-conditional kernels resolve per dispatch; see "
+                "profile())")
 
     # ---- faultinj ---------------------------------------------------------
     @staticmethod
@@ -1086,6 +1099,22 @@ class PlanExecutor:
             m.io_decode_ms += (time.perf_counter() - t0) * 1e3
         return t
 
+    @staticmethod
+    def _kernel_choice(op: str, sig, m: Optional[OperatorMetrics] = None,
+                       pin_degraded: bool = True):
+        """Resolve one registry dispatch (ops/registry.py, docs/kernels.md)
+        and stamp the choice on the operator's metrics. On the degraded CPU
+        tier the backend is pinned to "cpu" (default_backend still reports
+        the quarantined platform under jax.default_device): auto-selection
+        must not hand work back to the device the breaker just isolated."""
+        from ..ops.registry import REGISTRY
+        backend = "cpu" if (pin_degraded and m is not None
+                            and m.degraded) else None
+        choice = REGISTRY.select(op, sig, backend=backend)
+        if m is not None:
+            m.kernel = choice.label
+        return choice
+
     def _exec_eager_node(self, node, childs: List[Table], inputs, schemas,
                          m: OperatorMetrics) -> Table:
         ops = _ops()
@@ -1106,14 +1135,24 @@ class PlanExecutor:
         if isinstance(node, FusedSelect):
             # fused Filter+Project: gather ONLY the projection-referenced
             # columns through the mask, then project — one pass, instead of
-            # materializing the full filtered child first
+            # materializing the full filtered child first. The registry
+            # (ops/registry.py) may hand the front half to the Pallas
+            # predicate+compaction kernel; the XLA mask+gather is the
+            # fallback.
             (t,) = childs
-            mask = node.predicate.evaluate(t)
-            needed = sorted(set().union(
-                *(e.references() for _, e in node.exprs)))
-            if not needed:              # all-literal projection: any column
-                needed = [t.names[0]]   # carries the filtered row count
-            ft = ops.apply_boolean_mask(t.select(needed), mask)
+            from ..ops import select_pallas
+            # one shared definition with make_signature: the supports()
+            # gate must describe exactly the columns the kernel is handed
+            needed = select_pallas.needed_columns(t, node.exprs)
+            choice = self._kernel_choice(
+                "fused_select",
+                select_pallas.make_signature(t, node.predicate, node.exprs,
+                                             "eager"), m)
+            if not choice.fallback:
+                ft = choice.fn(t, node.predicate, needed)
+            else:
+                mask = node.predicate.evaluate(t)
+                ft = ops.apply_boolean_mask(t.select(needed), mask)
             return self._project(ft, node)
         if isinstance(node, Project):
             (t,) = childs
@@ -1122,8 +1161,16 @@ class PlanExecutor:
             lt, rt = childs
             lkeys = [lt[k] for k in node.left_keys]
             rkeys = [rt[k] for k in node.right_keys]
+            from ..ops import join_pallas
+            choice = self._kernel_choice(
+                "hash_join",
+                join_pallas.make_signature(lkeys, rkeys, node.how, "eager"),
+                m)
             if node.how == "inner":
-                lm, rm = ops.inner_join(lkeys, rkeys)
+                if not choice.fallback:
+                    lm, rm = choice.fn(lkeys, rkeys)
+                else:
+                    lm, rm = ops.inner_join(lkeys, rkeys)
                 return Table(
                     list(ops.take_table(lt, lm.data,
                                         _has_negative=False).columns) +
@@ -1137,6 +1184,12 @@ class PlanExecutor:
             (t,) = childs
             if not node.keys:
                 return self._global_aggregate(t, node)
+            # dispatch happens inside groupby_aggregate (registry op
+            # "groupby"); re-selecting here only stamps the choice. Backend
+            # intentionally NOT pinned for the degraded tier: the scan/
+            # scatter pick keys on jax.default_backend(), exactly like the
+            # kernel itself
+            self._kernel_choice("groupby", None, m, pin_degraded=False)
             agg = ops.groupby_aggregate(t, list(node.keys),
                                         [(c, o) for c, o, _ in node.aggs])
             out_names = schemas[id(node)]
@@ -1147,6 +1200,14 @@ class PlanExecutor:
                                   ascending=list(node.ascending))
         if isinstance(node, TopK):
             (t,) = childs
+            from ..ops import topk_pallas
+            choice = self._kernel_choice(
+                "topk",
+                topk_pallas.make_signature(t, node.keys, node.ascending,
+                                           node.n, "eager"), m)
+            if not choice.fallback:
+                return choice.fn(t, list(node.keys), list(node.ascending),
+                                 node.n)
             t = ops.sort_table(t, key_names=list(node.keys),
                                ascending=list(node.ascending))
             return ops.slice_table(t, 0, min(node.n, t.num_rows))
@@ -1285,6 +1346,7 @@ class PlanExecutor:
         attempts = 0
         cache_hits = 0
         bytes_map: Dict[int, int] = {}
+        kernel_map: Dict[int, str] = {}
         last_caps = dict(caps)
         self.health.start_plan_attempt()
         if self.degrade != "off" and not self.health.admit():
@@ -1304,7 +1366,7 @@ class PlanExecutor:
             # anyway, a per-shape entry keeps each bytes_map true to ITS
             # trace, and the names guard fingerprint-shared undeclared
             # scans bound to differently-named tables
-            fn, bm, hit = self._jitted_capped(
+            fn, bm, km, hit = self._jitted_capped(
                 plan, schemas, caps_now,
                 tuple(sorted((n, tuple(t.names), t.num_rows)
                              for n, t in inputs.items())))
@@ -1312,6 +1374,8 @@ class PlanExecutor:
             out = fn(dict(inputs))
             bytes_map.clear()
             bytes_map.update(bm)    # bm fills during the first trace
+            kernel_map.clear()
+            kernel_map.update(km)
             return out
 
         retries = 0
@@ -1368,7 +1432,8 @@ class PlanExecutor:
                 label=node.label, kind=node.kind, describe=node.describe(),
                 rows_in=rows_in, rows_out=rows_out,
                 bytes_out=bytes_map.get(i, 0),
-                escalations=escal if uses_cap else 0)
+                escalations=escal if uses_cap else 0,
+                kernel=kernel_map.get(i, ""))
             if isinstance(node, Scan) and node.source in scan_io:
                 io = scan_io[node.source]
                 mm = metrics[node.label]
@@ -1386,22 +1451,32 @@ class PlanExecutor:
     def _jitted_capped(self, plan, schemas, caps, input_key):
         # the canonical FINGERPRINT is the key: structurally equivalent
         # plans built independently (same kinds/exprs/schemas/DAG shape)
-        # share one compiled program instead of re-tracing. Returns
-        # (jitted_fn, bytes_map, cache_hit).
-        key = (plan.fingerprint, tuple(sorted(caps.items())), input_key)
+        # share one compiled program instead of re-tracing. The backend +
+        # kernel-override knob join the key: registry selection happens at
+        # trace time, so a program compiled under one kernel choice must
+        # never serve another (docs/kernels.md). Returns (jitted_fn,
+        # bytes_map, kernel_map, cache_hit).
+        from .. import config
+        kern_key = (jax.default_backend(),
+                    tuple(sorted(config.kernel_overrides().items())))
+        key = (plan.fingerprint, tuple(sorted(caps.items())), input_key,
+               kern_key)
         hit = self._jit_cache.get(key)
         if hit is not None:
-            return hit[0], hit[1], True
+            return hit[0], hit[1], hit[2], True
         bytes_map: Dict[int, int] = {}
+        kernel_map: Dict[int, str] = {}
 
         def fn(tables: Dict[str, Table]):
-            return self._run_capped(plan, schemas, caps, tables, bytes_map)
+            return self._run_capped(plan, schemas, caps, tables, bytes_map,
+                                    kernel_map)
 
         jitted = jax.jit(fn)
-        self._jit_cache[key] = (jitted, bytes_map)
-        return jitted, bytes_map, False
+        self._jit_cache[key] = (jitted, bytes_map, kernel_map)
+        return jitted, bytes_map, kernel_map, False
 
-    def _run_capped(self, plan, schemas, caps, tables, bytes_map):
+    def _run_capped(self, plan, schemas, caps, tables, bytes_map,
+                    kernel_map):
         from ..runtime.admission import operand_nbytes
         rels: Dict[int, _CappedRel] = {}
         # counts/bytes key on the toposort index: stable across
@@ -1411,7 +1486,7 @@ class PlanExecutor:
         for i, node in enumerate(plan.nodes):
             childs = [rels[id(c)] for c in node.children]
             rel, ovf = self._exec_capped_node(node, i, childs, tables,
-                                              schemas, caps)
+                                              schemas, caps, kernel_map)
             if ovf is not None:
                 overflow = overflow | ovf
             bytes_map[i] = operand_nbytes(rel.table)
@@ -1423,8 +1498,17 @@ class PlanExecutor:
         return root.table, root.alive, counts, overflow
 
     def _exec_capped_node(self, node, idx: int, childs: List[_CappedRel],
-                          tables, schemas, caps):
+                          tables, schemas, caps, kernel_map):
         ops = _ops()
+
+        def pick(op: str, sig):
+            # registry dispatch at trace time; choices key on the toposort
+            # index (like counts/bytes) so fingerprint-shared programs stamp
+            # consistently
+            from ..ops.registry import REGISTRY
+            choice = REGISTRY.select(op, sig)
+            kernel_map[idx] = choice.label
+            return choice
         if isinstance(node, Scan):
             t = tables[node.source]
             if node.projection is not None:
@@ -1439,8 +1523,14 @@ class PlanExecutor:
         if isinstance(node, FusedSelect):
             # filter-then-project over the padded frame: the predicate ANDs
             # into alive and the projection evaluates under the new mask
-            # (scalar aggregates reduce over the filtered live rows)
+            # (scalar aggregates reduce over the filtered live rows). No
+            # compaction happens here, so there is no Pallas form — the
+            # registry consult documents the decline (tier="capped")
             (c,) = childs
+            from ..ops import select_pallas
+            pick("fused_select",
+                 select_pallas.make_signature(c.table, node.predicate,
+                                              node.exprs, "capped"))
             mask = node.predicate.evaluate(c.table, c.alive)
             alive = c.alive & mask
             return _CappedRel(self._project(c.table, node, alive),
@@ -1453,11 +1543,20 @@ class PlanExecutor:
             l, r = childs
             lkeys = [l.table[k] for k in node.left_keys]
             rkeys = [r.table[k] for k in node.right_keys]
+            from ..ops import join_pallas
+            choice = pick("hash_join",
+                          join_pallas.make_signature(lkeys, rkeys, node.how,
+                                                     "capped"))
             if node.how == "inner":
                 row_cap = self._node_cap(caps, "row_cap", idx)
-                lm, rm, valid, ovf = ops.inner_join_capped(
-                    lkeys, rkeys, row_cap=row_cap, lalive=l.alive,
-                    ralive=r.alive)
+                if not choice.fallback:
+                    lm, rm, valid, ovf = join_pallas.inner_join_capped_pallas(
+                        lkeys, rkeys, row_cap=row_cap, lalive=l.alive,
+                        ralive=r.alive)
+                else:
+                    lm, rm, valid, ovf = ops.inner_join_capped(
+                        lkeys, rkeys, row_cap=row_cap, lalive=l.alive,
+                        ralive=r.alive)
                 cols = [ops.take(col, lm, _has_negative=False)
                         for col in l.table.columns]
                 cols += [ops.take(col, rm, _has_negative=False)
@@ -1475,6 +1574,7 @@ class PlanExecutor:
             if not node.keys:
                 t = self._global_aggregate(c.table, node, alive=c.alive)
                 return _CappedRel(t, jnp.ones((1,), bool)), None
+            pick("groupby", None)   # dispatch inside groupby_aggregate_capped
             key_cap = self._node_cap(caps, "key_cap", idx)
             agg, valid, ovf = ops.groupby_aggregate_capped(
                 c.table, list(node.keys), [(cn, o) for cn, o, _ in node.aggs],
@@ -1489,8 +1589,21 @@ class PlanExecutor:
             return _CappedRel(t, alive), None
         if isinstance(node, TopK):
             # fused Sort+Limit: dead rows sink in the capped sort, then the
-            # first n LIVE rows survive via the inclusive prefix count
+            # first n LIVE rows survive via the inclusive prefix count. The
+            # Pallas kernel instead returns the top-n live rows directly
+            # (narrower frame, same live set — downstream capped operators
+            # accept any row count)
             (c,) = childs
+            from ..ops import topk_pallas
+            choice = pick("topk",
+                          topk_pallas.make_signature(c.table, node.keys,
+                                                     node.ascending, node.n,
+                                                     "capped"))
+            if not choice.fallback:
+                t, alive = topk_pallas.topk_capped(
+                    c.table, list(node.keys), list(node.ascending), node.n,
+                    c.alive)
+                return _CappedRel(t, alive), None
             t, alive = ops.sort_table_capped(
                 c.table, key_names=list(node.keys),
                 ascending=list(node.ascending), alive=c.alive)

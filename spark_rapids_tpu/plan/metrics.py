@@ -31,6 +31,12 @@ class OperatorMetrics:
     escalations: int = 0       # cap-growth retries charged to this node
     backoff_ms: float = 0.0    # time spent backing off before retries
     degraded: bool = False     # ran on the degraded CPU tier (breaker open)
+    # kernel-registry choice for operators with registered alternatives
+    # (ops/registry.py, docs/kernels.md): "pallas:fused_select",
+    # "scan:groupby", "xla:topk", ... — trajectory numbers must never
+    # silently compare kernel backends (same rule as the bench `backend`
+    # stamp). Empty for operators with no registry dispatch.
+    kernel: str = ""
     # streaming-scan IO metrics (Scan nodes bound to a parquet source;
     # docs/io.md). Decode wall is host-side bitstream decode; overlap is
     # the time decode of chunk N+1 ran concurrently with executing chunk N
@@ -99,6 +105,8 @@ def render_profile(rows: List[OperatorMetrics],
                    f"{m.bytes_out:>12} {wall:>9} {m.retries:>5} "
                    f"{m.escalations:>5} {m.backoff_ms:>8.1f} "
                    f"{'yes' if m.degraded else '-':>4}")
+        if m.kernel:
+            out.append(f"  kernel: {m.kernel}")
         if m.io_row_groups_total:
             kept = m.io_row_groups_total - m.io_row_groups_pruned
             out.append(f"  io: row groups {kept}/{m.io_row_groups_total} "

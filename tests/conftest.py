@@ -72,6 +72,14 @@ TPU_SMOKE_PREFIXES = (
     "tests/test_relational.py::test_sort_float_nan_and_negzero",
     "tests/test_relational.py::test_inner_join_capped_matches_eager_under_jit",
     "tests/test_relational.py::test_groupby_capped_alive_excludes_dead_rows",
+    # Pallas kernel-registry tier (docs/kernels.md): one parity matrix per
+    # kernel family + the executor end-to-end. On the real chip these run
+    # interpret=False — the only tier that exercises the Mosaic lowering
+    # (CI parity elsewhere is interpret-mode on CPU).
+    "tests/test_kernel_registry.py::test_fused_select_dtype_matrix",
+    "tests/test_kernel_registry.py::test_topk_dtype_matrix",
+    "tests/test_kernel_registry.py::test_hash_join_dtype_matrix",
+    "tests/test_kernel_registry.py::test_forced_pallas_end_to_end_parity",
     "tests/test_row_conversion.py::test_word_and_concat_kernels_agree",
     "tests/test_copying.py::test_concat_fixed_and_strings",
 )
