@@ -119,6 +119,8 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
                 mesh_axis: str = None,
                 exchange_bytes: int = None,
                 kernels=None,
+                stats_hits: int = None,
+                adaptive: bool = None,
                 **extra) -> Dict:
     """Build + print one bench JSONL record.
 
@@ -129,7 +131,13 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
     (visible device count at emit time) is stamped the same way: a
     distributed-tier number measured over an N-way mesh is not comparable
     to a single-chip row, and the mesh width must never be inferred from
-    the bench name (docs/distributed.md).
+    the bench name (docs/distributed.md). `adaptive` (whether the
+    per-fingerprint stats store was active at emit time) and `stats_hits`
+    (the active store's cumulative consult hits) are stamped on EVERY
+    row for the same reason (plan/stats.py, docs/adaptive.md): a warm,
+    self-tuned number must never silently compare against a cold one.
+    Both auto-fill from the active store; pass them explicitly to
+    override (e.g. per-phase deltas in benchmarks/adaptive_bench.py).
 
     Optional distributed fields (the `*_dist` plan variants and the
     nightly distributed-parity stage record these): `mesh_axis` (the mesh
@@ -166,6 +174,15 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
            "rows_per_s": round(n_rows / (ms * 1e-3)),
            "backend": jax.default_backend(),
            "n_devices": len(jax.devices())}
+    if adaptive is None or stats_hits is None:
+        from spark_rapids_tpu.plan import stats as _stats
+        store = _stats.active_store()
+        if adaptive is None:
+            adaptive = store is not None
+        if stats_hits is None:
+            stats_hits = 0 if store is None else store.hits
+    rec["adaptive"] = bool(adaptive)
+    rec["stats_hits"] = int(stats_hits)
     if impl is not None:
         rec["impl"] = impl
     if mesh_axis is not None:

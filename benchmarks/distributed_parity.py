@@ -15,6 +15,17 @@ the same helper the bench_nds_q*.py `*_dist` configs use), asserting:
 Emits one JSONL row per query with `n_devices`/`mesh_axis`/
 `exchange_bytes` plus planned/observed exchange kinds and elision counts,
 so the BENCH history tracks the distributed trajectory across revisions.
+
+Runs with the per-fingerprint stats store SCOPED OFF (plan/stats.py):
+this gate asserts the STATIC exchange planner's broadcast+shuffle mix —
+coverage of both distributed join paths. With adaptivity live, the
+single-device reference run feeds observed (post-filter, tiny) build
+sides to the distributed run's planner, which then legitimately
+broadcasts every join — correct behavior, but it would silently drop the
+shuffle path from this gate's coverage. Adaptive exchange decisions get
+their own gate in benchmarks/adaptive_bench.py (docs/adaptive.md), and
+the JSONL rows here stamp `adaptive: false` so the history can't mix
+the two.
 """
 import sys
 
@@ -51,6 +62,12 @@ def _join_exchange_kinds(plan):
 
 
 def main(argv=None):
+    from spark_rapids_tpu.plan import stats as stats_mod
+    with stats_mod.scoped_store(None):      # static-planner gate: see
+        return _main(argv)                  # module docstring
+
+
+def _main(argv=None):
     args = parse_args(argv)
     n = max(int(100_000 * args.scale), 10_000)   # keep cs above the
     #                                              broadcast threshold

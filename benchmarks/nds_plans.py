@@ -166,10 +166,26 @@ def run_plan_variants(bench: str, axes: dict, plan, inputs, *,
     parity between the two, and record rows/bytes deltas + optimizer
     fields on the JSONL rows (docs/optimizer.md). Shared by the four
     bench_nds_q*.py plan configs and ci/nightly.sh's optimizer-parity
-    stage, so the bench numbers and the parity gate can never drift."""
+    stage, so the bench numbers and the parity gate can never drift.
+
+    Runs with the stats store SCOPED OFF: this is the STATIC
+    optimizer-off-vs-on A/B — with adaptivity live, the "off" variant's
+    execution would record observations the "on" variant consumes, and
+    the measured rules_fired/bytes deltas would silently describe a warm
+    hybrid instead of the static rules (docs/adaptive.md; the adaptive
+    cold/warm trajectory has its own gate, benchmarks/adaptive_bench.py).
+    The JSONL rows stamp `adaptive: false` accordingly."""
     from spark_rapids_tpu.plan import PlanExecutor
+    from spark_rapids_tpu.plan import stats as stats_mod
     from benchmarks.common import run_config
 
+    with stats_mod.scoped_store(None):
+        return _plan_variants_static(bench, axes, plan, inputs, n_rows,
+                                     iters, caps, PlanExecutor, run_config)
+
+
+def _plan_variants_static(bench, axes, plan, inputs, n_rows, iters, caps,
+                          PlanExecutor, run_config):
     results, totals, recs = {}, {}, []
     for optimized in (False, True):
         label = "on" if optimized else "off"

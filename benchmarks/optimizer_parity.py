@@ -64,19 +64,28 @@ def main(argv=None):
             f"{name}: pruning saved no sink bytes ({on})"
 
     # fingerprint-keyed program reuse: a structurally REBUILT q3 plan must
-    # hit the compiled-program cache (no re-trace), recorded in the JSONL
+    # hit the compiled-program cache (no re-trace), recorded in the JSONL.
+    # Stats scoped OFF: this asserts the STATIC fingerprint contract — a
+    # live store records the first run and could flip an observed-driven
+    # decision on the second, changing the optimized fingerprint and
+    # re-tracing legitimately (the adaptive trajectory has its own gate,
+    # benchmarks/adaptive_bench.py)
     from spark_rapids_tpu.plan import PlanExecutor
+    from spark_rapids_tpu.plan import stats as stats_mod
     _, inputs, _ = cases["q3"]
-    ex = PlanExecutor(mode="capped")
-    ex.execute(q3_plan(), inputs)
-    n_programs = len(ex._jit_cache)
-    res = ex.execute(q3_plan(), inputs)          # independently rebuilt
-    assert res.jit_cache_hits >= 1, "rebuilt plan missed the jit cache"
-    assert len(ex._jit_cache) == n_programs, "rebuilt plan re-traced"
-    n_rows = sum(t.num_rows for t in inputs.values())
-    emit_record("optimizer_fingerprint_reuse", {"num_rows": n_rows},
-                res.wall_ms, n_rows, impl="plan_capped", optimizer="on",
-                jit_cache_hits=res.jit_cache_hits)
+    with stats_mod.scoped_store(None):
+        ex = PlanExecutor(mode="capped")
+        ex.execute(q3_plan(), inputs)
+        n_programs = len(ex._jit_cache)
+        res = ex.execute(q3_plan(), inputs)      # independently rebuilt
+        assert res.jit_cache_hits >= 1, "rebuilt plan missed the jit cache"
+        assert len(ex._jit_cache) == n_programs, "rebuilt plan re-traced"
+        n_rows = sum(t.num_rows for t in inputs.values())
+        # emit inside the scope: the row's adaptive stamp must describe
+        # the measured (static) run, not the process default at exit
+        emit_record("optimizer_fingerprint_reuse", {"num_rows": n_rows},
+                    res.wall_ms, n_rows, impl="plan_capped",
+                    optimizer="on", jit_cache_hits=res.jit_cache_hits)
     print("optimizer parity OK", file=sys.stderr)
 
 

@@ -28,6 +28,13 @@ JAX_PLATFORMS=cpu python benchmarks/chaos_soak.py --scale 0.2 --cpu
 # counts on q5/q72, and a fingerprint-keyed jit-cache hit on a rebuilt
 # plan; emits optimizer/rules_fired JSONL fields
 JAX_PLATFORMS=cpu python benchmarks/optimizer_parity.py --scale 0.1 --cpu
+# adaptive-execution gate (docs/adaptive.md): NDS q5/q72 cold then warm
+# under a fresh per-fingerprint stats store — bit-exact parity (warm ==
+# cold == adaptivity-off), zero cap-escalation retries on the warm run
+# (observed-cap seeding across executor instances), >=1 stats-driven
+# build-side rewrite fired warm (through verify_rewrite), and warm wall
+# <= cold wall; every JSONL row carries adaptive/stats_hits stamps
+JAX_PLATFORMS=cpu python benchmarks/adaptive_bench.py --scale 0.1 --cpu
 # streaming-scan gate (docs/io.md): parquet-bound vs table-bound parity in
 # both tiers, nonzero row groups pruned on a selective predicate (with
 # measurably fewer decoded bytes), and decode/execute overlap > 0 with the

@@ -14,7 +14,11 @@ tables it runs over. Every case must satisfy three properties:
 3. (small plans — which all of these are) the optimized and unoptimized
    EAGER executions agree bit-for-bit, compacted row for row; a case
    whose unoptimized run raises must raise the same error class
-   optimized (semantics preserved means errors too).
+   optimized (semantics preserved means errors too);
+4. the plan executed TWICE under a fresh per-case stats store
+   (plan/stats.py) agrees bit-for-bit between the cold and warm runs,
+   error class included — adaptivity (observed-cardinality build sides,
+   cap seeding, kernel tie-breaks) may change *how*, never *what*.
 
 Determinism is a contract: `gen_case(seed)` builds the same DAG (same
 fingerprint) and the same table bytes every time — `random.Random(seed)`
@@ -57,12 +61,17 @@ class FuzzResult:
     optimized_verified: bool = True
     executed: bool = False
     parity: Optional[bool] = None
+    # property 4 (docs/adaptive.md): cold-vs-warm bit-exact parity under
+    # the stats store — adaptivity may change HOW, never WHAT (errors
+    # included)
+    adaptive_parity: Optional[bool] = None
     error: Optional[str] = None
 
     @property
     def ok(self) -> bool:
         return (self.verified and self.optimized_verified
-                and self.error is None and self.parity is not False)
+                and self.error is None and self.parity is not False
+                and self.adaptive_parity is not False)
 
 
 # ---- deterministic relation/expression generation ---------------------------
@@ -335,18 +344,49 @@ def run_case(case: FuzzCase, *, execute: bool = True) -> FuzzResult:
     if not execute:
         return res
     res.executed = True
+    from ..plan import stats as stats_mod
     outs = {}
-    for optimized in (False, True):
-        ex = PlanExecutor(mode="eager", optimize=optimized)
-        try:
-            r = ex.execute(case.plan, dict(case.tables))
-            outs[optimized] = ("ok", r.compact().to_pydict())
-        except Exception as e:     # parity includes error parity
-            outs[optimized] = ("err", type(e).__name__)
+    # properties 1-3 measure the STATIC engine: scope adaptivity off, or
+    # a premerge/nightly corpus run (no pytest conftest, stats default
+    # ON) would record seed N's plans into the process-default store and
+    # run later parity checks warm — a failing seed replayed standalone
+    # would then see different optimizer decisions and not reproduce
+    with stats_mod.scoped_store(None):
+        for optimized in (False, True):
+            ex = PlanExecutor(mode="eager", optimize=optimized)
+            try:
+                r = ex.execute(case.plan, dict(case.tables))
+                outs[optimized] = ("ok", r.compact().to_pydict())
+            except Exception as e:     # parity includes error parity
+                outs[optimized] = ("err", type(e).__name__)
     res.parity = outs[False] == outs[True]
     if not res.parity:
         res.error = (f"eager parity broke: unoptimized={outs[False]!r} "
                      f"optimized={outs[True]!r}")
+        return res
+
+    # property 4: the same plan twice under a FRESH stats store — the
+    # first run records, the second consumes (cap seeds, observed
+    # cardinalities, kernel tie-breaks). Bit-exact parity, error class
+    # included: adaptivity may change how a plan executes, never what it
+    # returns (docs/adaptive.md). A fresh scoped store per case keeps
+    # the corpus deterministic regardless of what ran before.
+    runs = []
+    # path="": never inherit SPARK_RAPIDS_TPU_STATS_PATH — a persisted
+    # file would pre-warm the "cold" run and collect fuzz-plan garbage
+    with stats_mod.scoped_store(stats_mod.StatsStore(capacity=32,
+                                                     path="")):
+        for _ in range(2):
+            ex = PlanExecutor(mode="eager", optimize=True)
+            try:
+                r = ex.execute(case.plan, dict(case.tables))
+                runs.append(("ok", r.compact().to_pydict()))
+            except Exception as e:
+                runs.append(("err", type(e).__name__))
+    res.adaptive_parity = runs[0] == runs[1]
+    if not res.adaptive_parity:
+        res.error = (f"adaptive parity broke: cold={runs[0]!r} "
+                     f"warm={runs[1]!r}")
     return res
 
 

@@ -604,7 +604,14 @@ def verify_rewrite(authored, optimized, *, bound=None,
     side conditions (only inside order-unobservable regions, never under
     floating-point inputs or a `mean` aggregate, whose reductions are not
     reorder-exact). `report` (the OptimizeReport) scopes the swap check to
-    executions where the rule actually fired."""
+    executions where the rule actually fired — and supplies the per-join
+    decision source (hint / observed:<runs> / default, docs/adaptive.md),
+    so a violation on a STATS-DRIVEN swap names the observations that
+    picked it. This gate is not optional for adaptive rewrites: the
+    executor runs it on every observed-driven rewrite even with
+    SPARK_RAPIDS_TPU_VERIFY_PLANS off (PlanExecutor._optimized), because
+    the stats store may change WHICH rewrites fire but must never weaken
+    the invariants they are checked against."""
     out = verify(optimized, bound=bound, input_dtypes=input_dtypes,
                  float_inputs=float_inputs, planned=planned)
     if float_inputs is None:
@@ -650,6 +657,24 @@ def verify_rewrite(authored, optimized, *, bound=None,
             swapped.append(n)
     if not swapped:
         return out
+
+    def _src(n) -> str:
+        """Decision-source suffix for a swap violation: which estimate
+        tier picked a swap. Only `swap (...)` stamps qualify — the
+        fixpoint pass re-stamps the SWAPPED node's own label with a
+        `keep` (its reversed sides never re-qualify under the 2x
+        hysteresis), which describes the post-swap confirmation, not the
+        decision under scrutiny. Diagnostic only — legality never
+        depends on where the cardinalities came from."""
+        sources = getattr(report, "decision_sources", None) or {}
+        got = sources.get(f"{n.label}/build_side")
+        if got is None or not got.startswith("swap"):
+            swaps = [v for k, v in sorted(sources.items())
+                     if k.endswith("/build_side")
+                     and v.startswith("swap")]
+            got = swaps[0] if len(swaps) == 1 else None
+        return f" (decision source: {got})" if got else ""
+
     if float_inputs or _plan_has_mean(optimized.nodes) \
             or _plan_has_mean(authored.nodes):
         for n in swapped:
@@ -657,7 +682,7 @@ def verify_rewrite(authored, optimized, *, bound=None,
                     f"{n.label}: build-side swap under floating-point "
                     "inputs (or a mean aggregate) — fp reductions are "
                     "not reorder-exact on m:n joins, so the swapped "
-                    "pair enumeration changes the bits")
+                    f"pair enumeration changes the bits{_src(n)}")
         return out
     from ..plan.optimizer import _order_safe_ids
     safe = _order_safe_ids(optimized.root)
@@ -667,5 +692,5 @@ def verify_rewrite(authored, optimized, *, bound=None,
                     f"{n.label}: build-side swap where the join's output "
                     "row order is observable (not every path to the root "
                     "crosses a HashAggregate) — results would no longer "
-                    "be row-for-row identical")
+                    f"be row-for-row identical{_src(n)}")
     return out

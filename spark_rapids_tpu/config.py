@@ -28,11 +28,16 @@ equivalents, all read at use time (not import time) so tests can monkeypatch:
 | SPARK_RAPIDS_TPU_BROADCAST_ROWS  | 8192 | distributed tier: estimated build-side rows at or below which exchange_planning picks a broadcast join over a shuffle |
 | SPARK_RAPIDS_TPU_DIST_SLACK      | 2.0  | distributed tier: initial per-bucket slack factor for hash/range exchanges (grows geometrically on overflow) |
 | SPARK_RAPIDS_TPU_VERIFY_PLANS    | 0    | static plan verifier gate (analysis/verifier.py): 1 verifies every plan pre-execution and every optimizer rule's output; on in tests (conftest), off in production |
+| SPARK_RAPIDS_TPU_STATS           | on   | per-fingerprint operator-stats store (plan/stats.py, docs/adaptive.md): observed cardinalities drive join build sides / exchange modes, cap seeding, chunk sizing, and kernel tie-breaks; "off" restores fully static decisions |
+| SPARK_RAPIDS_TPU_STATS_CAPACITY  | 256  | stats store LRU bound: per-(backend, fingerprint) plan entries retained (subtree/kernel tables scale off this) |
+| SPARK_RAPIDS_TPU_STATS_PATH      | —    | optional JSONL persistence path for the stats store: records append per successful execution and load at first use, so observed stats survive the process |
 
 The SPARK_RAPIDS_TPU_BREAKER_* numeric knobs are snapshotted when a
 `DeviceHealthMonitor` is constructed (one policy per monitor lifetime —
 construct a new monitor/executor, or pass constructor overrides, to
-re-tune); everything else in the table is read at use time.
+re-tune); SPARK_RAPIDS_TPU_STATS_CAPACITY/_PATH likewise snapshot when a
+`StatsStore` is constructed (plan/stats.reset_default_store re-reads);
+everything else in the table is read at use time.
 """
 from __future__ import annotations
 
@@ -190,6 +195,42 @@ def verify_plans() -> bool:
             f"SPARK_RAPIDS_TPU_VERIFY_PLANS={v!r}: expected 0, 1, on, "
             "or off")
     return v in ("1", "on")
+
+
+def stats_enabled() -> bool:
+    """Per-fingerprint operator-stats store gate (plan/stats.py,
+    docs/adaptive.md): when on, every successful PlanResult records its
+    observed rows/bytes/wall/caps/kernel timings and the optimizer,
+    executor, and kernel registry consult them on the next execution of
+    the same fingerprint. "off" restores byte-identical static decisions
+    (the store is neither read nor written). Same strict-typo policy as
+    the kernel selectors — a typo must not silently change whether runs
+    self-tune. The test suite defaults this OFF (tests/conftest.py):
+    cross-test fingerprint reuse would make cap-escalation and
+    optimizer-report assertions order-dependent; tests/test_adaptive.py
+    scopes explicit stores instead."""
+    v = os.environ.get("SPARK_RAPIDS_TPU_STATS", "on")
+    if v not in ("on", "off"):
+        raise ValueError(
+            f"SPARK_RAPIDS_TPU_STATS={v!r}: expected on or off")
+    return v == "on"
+
+
+def stats_capacity() -> int:
+    """Stats store LRU bound: plan entries per (backend, fingerprint)
+    retained before the least-recently-consulted evicts; the subtree-
+    cardinality and kernel-timing side tables scale off this bound
+    (plan/stats.py). Snapshotted when a StatsStore is constructed."""
+    return max(1, _int_env("SPARK_RAPIDS_TPU_STATS_CAPACITY", 256))
+
+
+def stats_path() -> str:
+    """Optional JSONL persistence path for the stats store: when set,
+    each successful execution appends one record and the process-default
+    store replays the file at first use — observed caps/cardinalities
+    survive restarts. Empty string (default) keeps the store
+    in-memory-only. Snapshotted when a StatsStore is constructed."""
+    return os.environ.get("SPARK_RAPIDS_TPU_STATS_PATH", "")
 
 
 def faultinj_config_path() -> str:

@@ -28,6 +28,14 @@ The executor stamps the winning choice on `OperatorMetrics.kernel`
 backend into the capped tier's jit-cache key, so compiled programs never
 alias across kernel selections.
 
+With the per-fingerprint stats store active (plan/stats.py,
+docs/adaptive.md), `select()` additionally consults OBSERVED timings: a
+candidate that has benched slower than its fallback on this exact
+(op, backend, signature) shape loses the tie-break — declined with the
+measured numbers, `stats_demoted` stamped on the choice. The capped
+tier's jit-cache key folds in the store's `kernel_epoch` so compiled
+programs never alias across demotion states.
+
 Providers register lazily: importing this module imports nothing heavy;
 the first `select(op)` imports the module listed in `_PROVIDERS`, whose
 import-time registration fills the catalog.
@@ -92,13 +100,18 @@ class Kernel:
 class KernelChoice:
     """One resolved dispatch. `declined` records every better-ranked kernel
     that was passed over and why — observability for 'why did my Pallas
-    kernel not run' without a debugger."""
+    kernel not run' without a debugger. `stats_demoted` marks a pick the
+    stats store changed: a better-ranked kernel had benched slower than
+    its fallback on this exact (op, backend, signature) shape and lost
+    the tie-break (plan/stats.py, docs/adaptive.md) — the loss itself is
+    in `declined` with the observed timings."""
 
     op: str
     name: str
     fn: Optional[Callable]
     fallback: bool
     declined: Tuple[Tuple[str, str], ...] = ()
+    stats_demoted: bool = False
 
     @property
     def label(self) -> str:
@@ -181,12 +194,34 @@ class KernelRegistry:
         self._ov_validated = key
         return ov
 
+    @staticmethod
+    def _stats_verdict(op: str, backend: str, name: str,
+                       fallback_name: str, sig: Optional[Signature]):
+        """Consult the stats store's observed kernel timings for this
+        exact (op, backend, signature): a non-None (candidate, fallback)
+        ms-per-1k-rows pair means the candidate has benched slower than
+        its fallback past the hysteresis margin and must lose the
+        tie-break (docs/adaptive.md). None — cold, store disabled, or
+        the candidate holds up — leaves selection static."""
+        if sig is None:
+            return None
+        from ..plan import stats as _stats
+        store = _stats.active_store()
+        if store is None:
+            return None
+        return store.kernel_slower(backend, op, sig, name, fallback_name)
+
     def select(self, op: str, sig: Optional[Signature] = None,
                backend: Optional[str] = None) -> KernelChoice:
         """Resolve `op` for `backend` (default: jax.default_backend()) and
         `sig`. Never raises on signatures — unsupported ones decline down
         the candidate list to the fallback; raises only on unknown op /
-        override names (strict-typo policy)."""
+        override names (strict-typo policy). With the stats store active
+        (plan/stats.py), a candidate that has benched slower than the
+        fallback on this exact signature is DEMOTED — declined with the
+        observed timings and `stats_demoted` stamped on the choice; a
+        forced override outranks the demotion (an explicit pin is the
+        operator saying 'measure it anyway')."""
         self._ensure(op)
         ks = self._ops[op]
         overrides = self._overrides()
@@ -232,13 +267,25 @@ class KernelRegistry:
             return KernelChoice(op, fb.name, fb.fn, True, tuple(declined))
         # auto: backend-exact non-fallbacks first, then universal
         # non-fallbacks, then the fallback — registration order within a rank
+        demoted = False
         for rank in (lambda k: not k.fallback and backend in k.backends,
                      lambda k: not k.fallback and "*" in k.backends):
             for k in ks:
                 if rank(k) and ok(k):
+                    verdict = self._stats_verdict(op, backend, k.name,
+                                                  fb.name, sig)
+                    if verdict is not None:
+                        declined.append(
+                            (k.name,
+                             "stats: benched %.4g ms/1k rows vs fallback "
+                             "%.4g on this signature" % verdict))
+                        demoted = True
+                        continue
                     return KernelChoice(op, k.name, k.fn, k.fallback,
-                                        tuple(declined))
-        return KernelChoice(op, fb.name, fb.fn, True, tuple(declined))
+                                        tuple(declined),
+                                        stats_demoted=demoted)
+        return KernelChoice(op, fb.name, fb.fn, True, tuple(declined),
+                            stats_demoted=demoted)
 
     def summary(self, backend: Optional[str] = None) -> Dict[str, str]:
         """op -> signature-independent choice name for `backend` — the

@@ -53,7 +53,9 @@ from .nodes import (Exchange, Filter, FusedSelect, HashAggregate, HashJoin,
 from .builder import Plan, PlanBuilder, PlanValidationError
 from .executor import PlanExecutor, PlanResult
 from .metrics import OperatorMetrics
-from .optimizer import OptimizeReport, optimize, plan_fingerprint
+from .optimizer import (OptimizeReport, optimize, plan_fingerprint,
+                        subtree_fingerprints)
+from .stats import StatsStore, active_store, scoped_store
 
 __all__ = [
     "col", "lit", "scalar_max", "scalar_min", "scalar_sum", "Expr",
@@ -62,5 +64,7 @@ __all__ = [
     "PlanNode",
     "Plan", "PlanBuilder", "PlanValidationError",
     "PlanExecutor", "PlanResult", "OperatorMetrics",
-    "optimize", "plan_fingerprint", "OptimizeReport",
+    "optimize", "plan_fingerprint", "subtree_fingerprints",
+    "OptimizeReport",
+    "StatsStore", "active_store", "scoped_store",
 ]

@@ -60,6 +60,18 @@ probe op decides whether normal execution resumes.
 Results carry `profile()` — per-operator rows (live rows in the capped
 tier, computed on-device and returned with the result), output buffer
 bytes, wall time, retry and cap-escalation counts.
+
+Feedback loop (plan/stats.py, docs/adaptive.md): after every successful
+execution the per-op metrics, final caps, and kernel timings record into
+the per-fingerprint stats store under the backend the result ran on
+("cpu" for degraded results). The next execution of the same fingerprint
+consumes them — observed cardinalities re-pick join build sides and
+exchange modes (through `optimize(stats=...)`, every stats-driven
+rewrite re-verified), the capped tier seeds its caps at the observed
+high-water (no escalation ladder on warm runs), the streaming tier sizes
+morsels from observed decode throughput, and the kernel registry demotes
+kernels that benched slower than their fallback. `SPARK_RAPIDS_TPU_STATS
+=off` restores fully static behavior.
 """
 from __future__ import annotations
 
@@ -470,14 +482,30 @@ class PlanExecutor:
         from .. import config
         if config.verify_plans():
             self._verify_execution(authored, plan, report, inputs, bound)
+        # the AUTHORED fingerprint keys the adaptive feedback loop
+        # (plan/stats.py): cold and warm executions of one authored plan
+        # share it even when a stats-driven rewrite changes the executed
+        # plan's fingerprint (so warm cap seeding survives a build-side
+        # flip via the global cap keys)
+        source_fp = authored.fingerprint
         if self.session is not None:
             from ..runtime.admission import active_session
             with active_session(self.session):
-                res = self._execute(plan, inputs, schemas)
+                res = self._execute(plan, inputs, schemas, source_fp)
         else:
-            res = self._execute(plan, inputs, schemas)
+            res = self._execute(plan, inputs, schemas, source_fp)
         if report is not None:
             res.optimizer = report.to_dict()
+        from . import stats as stats_mod
+        store = stats_mod.active_store()
+        if store is not None:
+            # record only what actually ran, under the backend it ran
+            # ON: a degraded result finished on the CPU tier and must
+            # never drive device-side decisions (docs/adaptive.md)
+            store.record_result(
+                plan, res,
+                backend="cpu" if res.degraded else jax.default_backend(),
+                source_fp=source_fp)
         return res
 
     def _verify_execution(self, authored, plan, report, inputs, bound):
@@ -546,23 +574,64 @@ class PlanExecutor:
         # verify mode changes which plan survives a mid-pipeline invalid
         # rewrite (per-rule fall-back), so it belongs in the cache key too
         verify_rules = config.verify_plans()
+        # adaptive rewrites consume the stats store's observations, so
+        # the store's generation joins the key: a cached rewrite must not
+        # outlive the observations it ignored (each successful execution
+        # records, so warm executions re-optimize — the rewrite pipeline
+        # is cheap next to execution, and only paid while stats are on)
+        from . import stats as stats_mod
+        store = stats_mod.active_store()
+        stats_gen = None if store is None else (store.uid,
+                                                store.generation)
         key = (plan.root, tuple(sorted(bound.items())),
                tuple(sorted((n, t.num_rows) for n, t in inputs.items())),
-               floats, streaming, mesh_peers, bc_rows, verify_rules)
+               floats, streaming, mesh_peers, bc_rows, verify_rules,
+               stats_gen)
         hit = self._opt_cache.get(key)
         if hit is None:
+            bound_rows = {n: t.num_rows for n, t in inputs.items()}
+            backend = jax.default_backend()
             opt, report = run_optimizer(
-                plan, bound, {n: t.num_rows for n, t in inputs.items()},
+                plan, bound, bound_rows,
                 float_inputs=floats, streaming_sources=streaming,
-                mesh_peers=mesh_peers, verify_rules=verify_rules)
+                mesh_peers=mesh_peers, verify_rules=verify_rules,
+                stats=store, backend=backend)
+            if (store is not None and not verify_rules
+                    and opt is not plan and not report.fell_back
+                    and report.stats_driven()):
+                # EVERY stats-driven rewrite passes the verify_rewrite
+                # gate, even with SPARK_RAPIDS_TPU_VERIFY_PLANS off
+                # (docs/adaptive.md): observations must never weaken the
+                # static pipeline's guarantees. A violation (defensive —
+                # the same rule guards protect both paths) reverts to
+                # the static rewrite rather than failing the query.
+                from ..analysis import verifier
+                input_dtypes = {
+                    name: {cn: c.dtype
+                           for cn, c in zip(t.names, t.columns)}
+                    for name, t in inputs.items() if isinstance(t, Table)}
+                rep = verifier.verify_rewrite(
+                    plan, opt, bound=bound, input_dtypes=input_dtypes,
+                    float_inputs=floats, report=report,
+                    # distributed plans: the partitioning-soundness
+                    # layer must check the very exchange placements the
+                    # observed cardinalities picked (same condition as
+                    # _verify_execution's `planned`)
+                    planned=bool(mesh_peers and mesh_peers > 1))
+                if not rep.ok:
+                    opt, report = run_optimizer(
+                        plan, bound, bound_rows,
+                        float_inputs=floats, streaming_sources=streaming,
+                        mesh_peers=mesh_peers, verify_rules=verify_rules)
+                    report.stats_reverted = True
             hit = (opt, opt.resolve_schemas(bound), report)
             self._opt_cache[key] = hit
         return hit
 
-    def _execute(self, plan, inputs, schemas):
+    def _execute(self, plan, inputs, schemas, source_fp=None):
         if self.mode == "eager":
             return self._execute_eager(plan, inputs, schemas)
-        return self._execute_capped(plan, inputs, schemas)
+        return self._execute_capped(plan, inputs, schemas, source_fp)
 
     def explain(self, plan: Plan, optimized: bool = False,
                 inputs: Optional[Dict[str, Table]] = None) -> str:
@@ -978,6 +1047,22 @@ class PlanExecutor:
         agg = chain[-1] if isinstance(chain[-1], HashAggregate) else None
         body = chain[1:-1] if agg is not None else chain[1:]
         chunk_rows = src.chunk_rows or config.io_chunk_rows() or None
+        if chunk_rows is None:
+            # adaptive morsel sizing (plan/stats.py, docs/adaptive.md):
+            # with no explicit bound, size chunks from this scan's
+            # OBSERVED decode throughput — the stream's exact two-phase
+            # merge makes the result chunking-invariant, so this only
+            # changes pacing, never bytes. Explicit knobs always win.
+            from . import stats as stats_mod
+            store = stats_mod.active_store()
+            if store is not None:
+                from .optimizer import subtree_fingerprints
+                # a Scan is a leaf: hashing it alone yields the same
+                # fingerprint record_result stored, without re-hashing
+                # the whole plan on the streaming hot path
+                scan_fp = subtree_fingerprints(scan)[id(scan)]
+                chunk_rows = store.suggest_chunk_rows(
+                    jax.default_backend(), scan_fp) or None
         depth = config.io_prefetch()
         gen = src.chunks(columns=columns, row_groups=kept,
                          chunk_rows=chunk_rows)
@@ -1113,6 +1198,13 @@ class PlanExecutor:
         choice = REGISTRY.select(op, sig, backend=backend)
         if m is not None:
             m.kernel = choice.label
+            if sig is not None:
+                # side-channel for the stats store (plan/stats.py): the
+                # op + signature this metric's wall time was measured
+                # under, consumed by record_result to feed the registry
+                # tie-break. A dynamic attribute, not a dataclass field —
+                # profile()/to_dict() rows must not grow a non-JSON blob.
+                m._kernel_sig = (op, sig)
         return choice
 
     def _exec_eager_node(self, node, childs: List[Table], inputs, schemas,
@@ -1310,7 +1402,8 @@ class PlanExecutor:
     def _node_cap(caps: Dict[str, int], which: str, idx: int) -> int:
         return caps.get(f"{which}:{idx}") or caps[which]
 
-    def _execute_capped(self, plan, inputs, schemas) -> PlanResult:
+    def _execute_capped(self, plan, inputs, schemas,
+                        source_fp=None) -> PlanResult:
         from ..parallel.autoretry import auto_retry_overflow
         # the capped tier traces ONE whole-plan program over concrete
         # shapes, so streaming sources materialize first — still through
@@ -1342,6 +1435,23 @@ class PlanExecutor:
         #                              caps memo and compiled programs
         for k, v in (self._caps_memo.get(fp) or {}).items():
             caps[k] = max(caps.get(k, 0), v)
+        # adaptive cap seeding (plan/stats.py, docs/adaptive.md): floor
+        # the starting capacities at the observed high-water marks from
+        # prior executions of this authored plan, so a repeat fingerprint
+        # compiles once instead of re-climbing the escalation ladder —
+        # the per-executor memo above, promoted across executor
+        # instances (and processes, with persistence on). Same
+        # floor-only contract: caps are STARTING capacities the overflow
+        # ladder would have grown anyway, so seeding can never change
+        # results, only skip retries. Keyed by the backend about to run:
+        # degraded-run stats recorded under "cpu" never seed a device.
+        from . import stats as stats_mod
+        store = stats_mod.active_store()
+        if store is not None and source_fp is not None:
+            for k, v in store.observed_caps(jax.default_backend(),
+                                            source_fp,
+                                            executed_fp=fp).items():
+                caps[k] = max(caps.get(k, 0), v)
         t0 = time.perf_counter()
         attempts = 0
         cache_hits = 0
@@ -1457,8 +1567,17 @@ class PlanExecutor:
         # never serve another (docs/kernels.md). Returns (jitted_fn,
         # bytes_map, kernel_map, cache_hit).
         from .. import config
+        from . import stats as stats_mod
+        store = stats_mod.active_store()
+        # the stats store's kernel tie-break resolves at trace time, so
+        # its epoch (bumped only when a recorded timing changes some
+        # signature's kernel ORDERING) joins the key: compiled programs
+        # stay shared across runs whose picks cannot have changed, and
+        # never alias across a demotion flip (docs/adaptive.md)
         kern_key = (jax.default_backend(),
-                    tuple(sorted(config.kernel_overrides().items())))
+                    tuple(sorted(config.kernel_overrides().items())),
+                    None if store is None else (store.uid,
+                                                store.kernel_epoch))
         key = (plan.fingerprint, tuple(sorted(caps.items())), input_key,
                kern_key)
         hit = self._jit_cache.get(key)

@@ -88,6 +88,16 @@ def render_profile(rows: List[OperatorMetrics],
                       f"(~{optimizer.get('pruned_bytes_est', 0)} B est)"
                       if pruned else "")
                    + f", fingerprint={optimizer.get('fingerprint', '')}")
+        # adaptive-execution provenance (plan/stats.py, docs/adaptive.md):
+        # where each build-side/exchange decision's cardinalities came
+        # from — a warm (observed-driven) profile must never read like a
+        # cold one
+        sources = optimizer.get("decision_sources") or {}
+        if sources:
+            tag = (" [STATS REVERTED]"
+                   if optimizer.get("stats_reverted") else "")
+            for key, src in sorted(sources.items()):
+                out.append(f"  decision {key}: {src}{tag}")
     if degraded:
         reason = (breaker or {}).get("reason")
         state = (breaker or {}).get("state", "open")

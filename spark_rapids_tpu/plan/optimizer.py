@@ -69,8 +69,9 @@ from .nodes import (Exchange, Filter, FusedSelect, HashAggregate, HashJoin,
                     Limit, PlanNode, PlanValidationError, Project, Scan,
                     Sort, TopK, Union)
 
-__all__ = ["optimize", "plan_fingerprint", "OptimizeReport", "RULE_NAMES",
-           "MAX_PASSES", "pruning_conjuncts", "split_conjuncts"]
+__all__ = ["optimize", "plan_fingerprint", "subtree_fingerprints",
+           "OptimizeReport", "RULE_NAMES", "MAX_PASSES",
+           "pruning_conjuncts", "split_conjuncts"]
 
 MAX_PASSES = 10           # fixpoint guard: rewrite passes, not rewrites
 _EST_BYTES_PER_CELL = 8   # the engine's INT64-tier column width
@@ -137,6 +138,33 @@ def plan_fingerprint(plan: Plan) -> str:
     toks = [(n.kind, _node_params(n),
              tuple(index[id(c)] for c in n.children)) for n in nodes]
     return hashlib.sha256(repr(toks).encode()).hexdigest()[:16]
+
+
+def _subtree_token_hash(node: PlanNode, child_fps) -> str:
+    """THE per-node subtree-hash definition — the single point the
+    store's record keys (subtree_fingerprints over the executed plan)
+    and the estimator's consult keys (_Estimator._subtree_fp over the
+    plan being optimized) both derive from; a second copy drifting would
+    silently make observed stats never match."""
+    toks = (node.kind, _node_params(node), tuple(child_fps))
+    return hashlib.sha256(repr(toks).encode()).hexdigest()[:16]
+
+
+def subtree_fingerprints(root: PlanNode) -> Dict[int, str]:
+    """node-id -> structural hash of the subtree BELOW each node (kind,
+    params, child subtree hashes — same token vocabulary as
+    `plan_fingerprint`, same `_FP_SKIP_FIELDS` hint exclusions). Two
+    occurrences of one operator subtree hash equal across plans and
+    across runs, which is what lets the stats store (plan/stats.py)
+    carry an observed output cardinality from an executed plan's node to
+    the structurally identical node the optimizer is re-estimating on
+    the next execution — and why a schema or parameter change (a stale
+    fingerprint) can never match."""
+    out: Dict[int, str] = {}
+    for n in _toposort(root):
+        out[id(n)] = _subtree_token_hash(
+            n, (out[id(c)] for c in n.children))
+    return out
 
 
 # ---- report -----------------------------------------------------------------
@@ -212,12 +240,48 @@ class OptimizeReport:
     exchanges: Dict[str, int] = dataclasses.field(default_factory=dict)
     exchanges_elided: int = 0
     sharding: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # adaptive execution (plan/stats.py, docs/adaptive.md): per rule
+    # firing, WHERE the cardinalities behind a build-side or
+    # exchange-mode choice came from — "<node label>/<rule>" ->
+    # "<decision> (hint | observed:<run count> | default)". "observed"
+    # means the stats store's recorded subtree cardinality drove the
+    # estimate; "hint" an `est_rows` scan hint; "default" bound table
+    # sizes / structural guesses. Trajectory numbers and explain output
+    # must never silently mix cold and warm decisions.
+    decision_sources: Dict[str, str] = dataclasses.field(
+        default_factory=dict)
+    # a stats-driven rewrite failed the verify_rewrite gate and the
+    # pipeline re-ran statically (defensive — the same guards protect
+    # both paths; see PlanExecutor._optimized)
+    stats_reverted: bool = False
 
     def rules_fired(self) -> Dict[str, int]:
         return {k: v for k, v in self.rules.items() if v}
 
     def total_rewrites(self) -> int:
         return sum(self.rules.values())
+
+    def stats_driven(self) -> bool:
+        """Whether an observed-sourced decision actually CHANGED the
+        plan: a build-side `swap` stamped from observed cardinalities,
+        or an observed-driven exchange-mode pick (which only exists when
+        exchange_planning placed boundaries). A `keep (observed:N)` is
+        the static outcome confirmed by observations — not a rewrite —
+        and must not trigger the executor's always-on verify_rewrite
+        gate on every warm production run of any join-bearing plan.
+        Exchange stamps are DELIBERATELY conservative the other way:
+        telling an observed pick apart from the identical static one
+        would need a parallel static estimate per join, so every
+        observed exchange decision counts — the extra verify walk is
+        proportionally small next to a distributed mesh execution."""
+        for key, v in self.decision_sources.items():
+            if "observed" not in v:
+                continue
+            if key.endswith("/exchange"):
+                return True
+            if key.endswith("/build_side") and v.startswith("swap"):
+                return True
+        return False
 
     def to_dict(self) -> Dict:
         return {"rules_fired": self.rules_fired(), "passes": self.passes,
@@ -229,7 +293,10 @@ class OptimizeReport:
                 "fallback": dict(self.fallback) if self.fallback else None,
                 "exchanges": dict(self.exchanges),
                 "exchanges_elided": self.exchanges_elided,
-                "sharding": dict(self.sharding)}
+                "sharding": dict(self.sharding),
+                "decision_sources": dict(self.decision_sources),
+                "stats_driven": self.stats_driven(),
+                "stats_reverted": self.stats_reverted}
 
     def summary(self) -> str:
         lines = [f"optimizer: {self.passes} pass(es), "
@@ -255,6 +322,13 @@ class OptimizeReport:
             lines.append("  sharding:")
             for label, spec in self.sharding.items():
                 lines.append(f"    {label}: {spec}")
+        if self.decision_sources:
+            lines.append("  decision sources"
+                         + (" [STATS REVERTED: observed-driven rewrite "
+                            "failed verify_rewrite, static decisions ran]"
+                            if self.stats_reverted else "") + ":")
+            for key, src in sorted(self.decision_sources.items()):
+                lines.append(f"    {key}: {src}")
         lines.append(f"  fingerprint {self.source_fingerprint} -> "
                      f"{self.fingerprint}")
         return "\n".join(lines)
@@ -335,50 +409,112 @@ class _Schemas:
         return s
 
 
-class _Estimator:
-    """Row-count estimates, bottom-up. Bound table sizes win; `est_rows`
-    scan hints fill in; None propagates (rules skip). Selectivity guesses
-    are crude on purpose — only the build_side rule consumes them, and it
-    swaps only on a 2x margin."""
+# estimate-source severity lattice: a decision that consumed ANY observed
+# cardinality is stats-driven; hints outrank structural defaults
+_SRC_RANK = {"default": 0, "hint": 1, "observed": 2}
 
-    def __init__(self, bound_rows: Optional[Dict[str, int]] = None):
+
+class _Estimator:
+    """Row-count estimates, bottom-up. OBSERVED subtree cardinalities
+    from the stats store (plan/stats.py) win for interior nodes; bound
+    table sizes win at scans; `est_rows` scan hints fill in; None
+    propagates (rules skip). Selectivity guesses are crude on purpose —
+    only the build_side and exchange rules consume them, both behind
+    margins. Alongside each estimate the SOURCE is tracked ("observed" /
+    "hint" / "default", plus the observed run count) so rule firings can
+    stamp their decision source on the report."""
+
+    def __init__(self, bound_rows: Optional[Dict[str, int]] = None,
+                 stats=None, backend: Optional[str] = None):
         self.bound = dict(bound_rows or {})
+        self.stats = stats          # plan/stats.StatsStore or None
+        self.backend = backend
         self.memo: Dict[int, Optional[float]] = {}
+        self.src: Dict[int, Tuple[str, Optional[int]]] = {}
+        self._subfp: Dict[int, str] = {}
 
     def of(self, node: PlanNode) -> Optional[float]:
         got = self.memo.get(id(node), _Estimator)
         if got is not _Estimator:
             return got
-        e = self._compute(node)
+        e, src, runs = self._compute(node)
         self.memo[id(node)] = e
+        if e is not None:
+            self.src[id(node)] = (src, runs)
         return e
 
-    def _compute(self, node: PlanNode) -> Optional[float]:
+    def source_of(self, *nodes: PlanNode) -> str:
+        """Rendered decision source over the nodes whose estimates fed
+        one rule decision: the severity-max of their sources, with the
+        smallest observed run count when observed (a decision is only as
+        warm as its coldest observation)."""
+        best, runs = "default", None
+        for n in nodes:
+            s, r = self.src.get(id(n), ("default", None))
+            if _SRC_RANK[s] > _SRC_RANK[best]:
+                best = s
+            if s == "observed" and r is not None:
+                runs = r if runs is None else min(runs, r)
+        return f"observed:{runs}" if best == "observed" else best
+
+    def _subtree_fp(self, node: PlanNode) -> str:
+        got = self._subfp.get(id(node))
+        if got is None:
+            got = _subtree_token_hash(
+                node, (self._subtree_fp(c) for c in node.children))
+            self._subfp[id(node)] = got
+        return got
+
+    def _observed(self, node: PlanNode) -> Optional[Tuple[int, int]]:
+        if self.stats is None or self.backend is None:
+            return None
+        return self.stats.observed_rows(self.backend,
+                                        self._subtree_fp(node))
+
+    def _compute(self, node: PlanNode
+                 ) -> Tuple[Optional[float], str, Optional[int]]:
         if isinstance(node, Scan):
-            v = self.bound.get(node.source, node.est_rows)
-            return None if v is None else float(v)
+            v = self.bound.get(node.source)
+            if v is not None:
+                return float(v), "default", None
+            obs = self._observed(node)
+            if obs is not None:
+                return float(obs[0]), "observed", obs[1]
+            if node.est_rows is not None:
+                return float(node.est_rows), "hint", None
+            return None, "default", None
+        obs = self._observed(node)
+        if obs is not None:
+            return float(obs[0]), "observed", obs[1]
         kids = [self.of(c) for c in node.children]
         if any(k is None for k in kids):
-            return None
+            return None, "default", None
+        src, runs = "default", None
+        for c in node.children:
+            s, r = self.src.get(id(c), ("default", None))
+            if _SRC_RANK[s] > _SRC_RANK[src]:
+                src = s
+            if s == "observed" and r is not None:
+                runs = r if runs is None else min(runs, r)
         if isinstance(node, (Filter, FusedSelect)):
-            return 0.5 * kids[0]
+            return 0.5 * kids[0], src, runs
         if isinstance(node, (Project, Exchange, Sort)):
-            return kids[0]
+            return kids[0], src, runs
         if isinstance(node, Limit):
-            return min(float(node.n), kids[0])
+            return min(float(node.n), kids[0]), src, runs
         if isinstance(node, TopK):
-            return min(float(node.n), kids[0])
+            return min(float(node.n), kids[0]), src, runs
         if isinstance(node, Union):
-            return sum(kids)
+            return sum(kids), src, runs
         if isinstance(node, HashJoin):
             if node.how == "inner":
-                return max(kids)
-            return 0.5 * kids[0]
+                return max(kids), src, runs
+            return 0.5 * kids[0], src, runs
         if isinstance(node, HashAggregate):
             if not node.keys:
-                return 1.0
-            return max(1.0, kids[0] / 10.0)    # distinct-key guess
-        return kids[0] if kids else None
+                return 1.0, src, runs
+            return max(1.0, kids[0] / 10.0), src, runs   # distinct guess
+        return (kids[0] if kids else None), src, runs
 
 
 # ---- rules ------------------------------------------------------------------
@@ -387,9 +523,10 @@ class _Estimator:
 
 class _Ctx:
     def __init__(self, root, bound, bound_rows, report,
-                 float_inputs=False, streaming=frozenset()):
+                 float_inputs=False, streaming=frozenset(),
+                 stats=None, backend=None):
         self.schemas = _Schemas(bound)
-        self.est = _Estimator(bound_rows)
+        self.est = _Estimator(bound_rows, stats, backend)
         self.shared = _shared_ids(root)
         self.report = report
         self.float_inputs = float_inputs
@@ -566,14 +703,23 @@ def _rule_build_side(root, ctx):
             rs = ctx.schemas.of(n.right)
             # 2x hysteresis: swap only on a clear margin so the rule is
             # stable (the swapped join's sides never re-qualify)
-            if None not in (le, re_, ls, rs) and le * 2 < re_:
-                hits[0] += 1
-                swapped = HashJoin(node2.right, node2.left, n.right_keys,
-                                   n.left_keys, how="inner",
-                                   row_cap=n.row_cap)
-                order = tuple(ls) + tuple(rs)   # restore authored order
-                node2 = Project(swapped,
-                                tuple((nm, col(nm)) for nm in order))
+            if None not in (le, re_, ls, rs):
+                swap = le * 2 < re_
+                # decision provenance (docs/adaptive.md): which estimate
+                # tier fed this choice — re-stamped each pass, so the
+                # fixpoint pass (where warm observed stats have become
+                # visible through the converged subtree shapes) wins
+                ctx.report.decision_sources[f"{n.label}/build_side"] = (
+                    f"{'swap' if swap else 'keep'} "
+                    f"({ctx.est.source_of(n.left, n.right)})")
+                if swap:
+                    hits[0] += 1
+                    swapped = HashJoin(node2.right, node2.left,
+                                       n.right_keys, n.left_keys,
+                                       how="inner", row_cap=n.row_cap)
+                    order = tuple(ls) + tuple(rs)  # restore authored order
+                    node2 = Project(swapped,
+                                    tuple((nm, col(nm)) for nm in order))
         memo[id(n)] = node2
         return node2
 
@@ -870,8 +1016,14 @@ def _plan_exchanges(root: PlanNode, ctx: "_Ctx", n_peers: int):
             l_new, r_new = kids
             le = ctx.est.of(n.left)
             re_ = ctx.est.of(n.right)
-            if (re_ is not None and re_ <= thresh
-                    and (le is None or re_ <= le)):
+            broadcast = (re_ is not None and re_ <= thresh
+                         and (le is None or re_ <= le))
+            # decision provenance, same vocabulary as build_side: what
+            # kind of estimate picked the exchange mode for this join
+            report.decision_sources[f"{n.label}/exchange"] = (
+                f"{'broadcast' if broadcast else 'shuffle'} "
+                f"({ctx.est.source_of(n.left, n.right)})")
+            if broadcast:
                 r_new = add_exchange(r_new, (), "broadcast")
             else:
                 if tuple(n.left_keys) in part[id(l_new)]:
@@ -973,25 +1125,29 @@ def _fall_back(plan: Plan, report: OptimizeReport):
     report.exchanges = {}
     report.exchanges_elided = 0
     report.sharding = {}
+    report.decision_sources = {}
     report.fingerprint = report.source_fingerprint
     return plan, report
 
 
 def _attribute_fallback(plan: Plan, bound, bound_rows, float_inputs,
                         streaming, mesh_peers,
-                        err: PlanValidationError) -> Dict:
+                        err: PlanValidationError,
+                        stats=None, backend=None) -> Dict:
     """Post-hoc attribution for the validate-or-fall-back net: re-run the
     pipeline from the authored root, re-validating after every rule that
     rewrites, to name the rule/node/invariant that produced the invalid
     DAG. Only runs on the (defensively impossible) fall-back path, so the
-    duplicated rule work costs nothing in the common case."""
+    duplicated rule work costs nothing in the common case. `stats`/
+    `backend` replay the SAME adaptive estimates the failing pipeline
+    consumed — attribution must reproduce the rewrite it is naming."""
     scratch = OptimizeReport(rules={name: 0 for name in RULE_NAMES})
     root = plan.root
     for _ in range(MAX_PASSES):
         pass_hits = 0
         for name, rule in _RULES:
             ctx = _Ctx(root, bound, bound_rows, scratch, float_inputs,
-                       streaming)
+                       streaming, stats, backend)
             try:
                 new_root, n = rule(root, ctx)
             except PlanValidationError as bad:
@@ -1006,7 +1162,7 @@ def _attribute_fallback(plan: Plan, bound, bound_rows, float_inputs,
             break
     if mesh_peers is not None and mesh_peers > 1:
         ctx = _Ctx(root, bound, bound_rows, scratch, float_inputs,
-                   streaming)
+                   streaming, stats, backend)
         try:
             new_root, _ = _plan_exchanges(root, ctx, mesh_peers)
         except PlanValidationError as bad:
@@ -1026,7 +1182,9 @@ def optimize(plan: Plan,
              float_inputs: bool = False,
              streaming_sources=frozenset(),
              mesh_peers: Optional[int] = None,
-             verify_rules: bool = False) -> Tuple[Plan, OptimizeReport]:
+             verify_rules: bool = False,
+             stats=None,
+             backend: Optional[str] = None) -> Tuple[Plan, OptimizeReport]:
     """Run the rule pipeline to fixpoint over `plan`. `bound` maps scan
     source -> actual column names and `bound_rows` -> actual row counts
     (execute() passes both; explain-time callers may pass neither and the
@@ -1046,6 +1204,13 @@ def optimize(plan: Plan,
     output as it lands instead of only net-validating the pipeline's end
     state — the first invalid rewrite falls back immediately with a
     precise (rule, node, invariant) diagnostic in `report.fallback`.
+    `stats` (a plan/stats.StatsStore) + `backend` make the estimator
+    observation-driven (docs/adaptive.md): recorded subtree
+    cardinalities for `backend` override the static estimate chain, and
+    every build-side/exchange decision stamps its source on
+    `report.decision_sources`. With stats=None (the
+    SPARK_RAPIDS_TPU_STATS=off path) decisions are byte-identical to
+    the static pipeline.
     Returns the optimized Plan (the SAME object when nothing fired) +
     the report."""
     report = OptimizeReport(rules={name: 0 for name in RULE_NAMES})
@@ -1057,7 +1222,7 @@ def optimize(plan: Plan,
             pass_hits = 0
             for name, rule in _RULES:
                 ctx = _Ctx(root, bound, bound_rows, report, float_inputs,
-                           streaming)
+                           streaming, stats, backend)
                 new_root, n = rule(root, ctx)
                 if verify_rules and new_root is not root:
                     # post-optimize assertion, per rule: every rule's
@@ -1077,7 +1242,7 @@ def optimize(plan: Plan,
                 break
         if mesh_peers is not None and mesh_peers > 1:
             ctx = _Ctx(root, bound, bound_rows, report, float_inputs,
-                       streaming)
+                       streaming, stats, backend)
             new_root, n = _plan_exchanges(root, ctx, mesh_peers)
             if verify_rules and new_root is not root:
                 bad = _plan_error(new_root, bound)
@@ -1094,7 +1259,7 @@ def optimize(plan: Plan,
         # culprit rather than the victim
         report.fallback = _attribute_fallback(
             plan, bound, bound_rows, float_inputs, streaming, mesh_peers,
-            err)
+            err, stats, backend)
         return _fall_back(plan, report)
     if root is plan.root:
         report.fingerprint = report.source_fingerprint
@@ -1111,7 +1276,7 @@ def optimize(plan: Plan,
         # invariant attributed post-hoc (analysis/verifier.py vocabulary)
         report.fallback = _attribute_fallback(
             plan, bound, bound_rows, float_inputs, streaming, mesh_peers,
-            err)
+            err, stats, backend)
         return _fall_back(plan, report)
     report.fingerprint = opt.fingerprint
     return opt, report

@@ -21,6 +21,15 @@ if not TPU_SMOKE:
 # verified pre-execution, and every optimizer rule's output re-validates.
 # setdefault so a test (or developer) can still export =0 to bisect.
 os.environ.setdefault("SPARK_RAPIDS_TPU_VERIFY_PLANS", "1")
+# Per-fingerprint stats store (plan/stats.py, docs/adaptive.md): OFF for
+# the suite. The store is process-global and keyed by STRUCTURAL
+# fingerprints, so with it on, a test's cap-escalation counts and
+# optimizer decisions would depend on which structurally identical plans
+# earlier tests happened to run — order-dependent assertions. Adaptive
+# behavior is tested deliberately in tests/test_adaptive.py (and the
+# fuzzer's two-run property) through explicit `scoped_store`s, which
+# outrank this default.
+os.environ.setdefault("SPARK_RAPIDS_TPU_STATS", "off")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags and not TPU_SMOKE:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
