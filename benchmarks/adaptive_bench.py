@@ -26,6 +26,7 @@ import sys
 sys.path.insert(0, ".")
 
 from benchmarks.common import emit_record, parse_args        # noqa: E402
+from benchmarks.nds_plans import kernels_of                  # noqa: E402
 from benchmarks.nds_plans import (q5_inputs, q5_plan,        # noqa: E402
                                   q72_inputs, q72_plan)
 
@@ -70,7 +71,7 @@ def _run(name, plan, inputs, caps, n_rows):
             recs.append(emit_record(
                 f"adaptive_{name}", {"phase": phase}, res.wall_ms, n_rows,
                 impl="plan_capped", optimizer="on", rules_fired=rules,
-                attempts=res.attempts,
+                attempts=res.attempts, kernels=kernels_of(res),
                 stats_hits=0 if store is None else store.hits - before,
                 adaptive=store is not None,
                 stats_decisions=sorted(_stats_decisions(res))))

@@ -29,12 +29,14 @@ def main(argv=None):
                         "sort_indices": sort_indices},
                        lambda c, b=bf, s=sort_indices:
                            bloom_filter_put(b, c, sort_indices=s).bits,
-                       (hashed,), n_rows=num_rows, iters=args.iters)
+                       (hashed,), n_rows=num_rows, iters=args.iters,
+                       kernels="fallback")
         full = bloom_filter_put(bf, hashed)
         run_config("bloom_filter_probe",
                    {"bloom_filter_bytes": bf_bytes, "num_rows": num_rows},
                    lambda c, b=full: bloom_filter_probe(c, b).data,
-                   (hashed,), n_rows=num_rows, iters=args.iters)
+                   (hashed,), n_rows=num_rows, iters=args.iters,
+                   kernels="fallback")
 
 
 if __name__ == "__main__":

@@ -23,7 +23,8 @@ def main(argv=None):
         run_config("string_to_float", {"num_rows": n_rows},
                    lambda c: string_to_float(c, dtypes.FLOAT32,
                                              pad_to=pad).data,
-                   (col,), n_rows=n_rows, iters=args.iters)
+                   (col,), n_rows=n_rows, iters=args.iters,
+                   kernels="fallback")
 
 
 if __name__ == "__main__":

@@ -5,7 +5,8 @@ import sys
 import numpy as np
 
 sys.path.insert(0, ".")
-from benchmarks.common import parse_args, run_config  # noqa: E402
+from benchmarks.common import (parse_args, registry_kernels,  # noqa: E402
+                               run_config)
 
 
 def main(argv=None):
@@ -26,8 +27,9 @@ def main(argv=None):
                    lambda tb: [c.data for c in groupby_aggregate(
                        tb, ["k"], [("v", "sum"), ("v", "count")]).columns],
                    (t,), n_rows=n_rows, iters=args.iters,
-                   jit=False)  # output size is data-dependent (one host
+                   jit=False,  # output size is data-dependent (one host
                                # sync); the kernel itself is jitted in-op
+                   kernels=registry_kernels("groupby"))
 
         # capped jit tier: static key_cap output, zero host syncs.
         # min(n_keys, n_rows) keeps smoke-scale caps meaningful (distinct
@@ -46,7 +48,8 @@ def main(argv=None):
         assert not bool(jax.jit(capped)(t)[2]), "key_cap overflow"
         run_config("groupby_sum_count_capped",
                    {"num_rows": n_rows, "num_keys": n_keys, "key_cap": cap},
-                   capped, (t,), n_rows=n_rows, iters=args.iters, jit=True)
+                   capped, (t,), n_rows=n_rows, iters=args.iters,
+                   jit=True, kernels=registry_kernels("groupby"))
 
 
 if __name__ == "__main__":

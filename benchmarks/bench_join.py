@@ -25,7 +25,8 @@ def main(argv=None):
     run_config("inner_join", {"left_rows": nl, "right_rows": nr},
                lambda l, r: [c.data for c in inner_join([l], [r])],
                (lk, rk), n_rows=nl, iters=args.iters,
-               jit=False)  # match count is data-dependent; kernels jitted in-op
+               jit=False,  # match count is data-dependent; kernels jitted in-op
+               kernels="fallback")  # ops.inner_join IS the universal lowering
 
     # capped jit tier: the whole join is ONE compiled program, no host sync
     # (~1 match/left row by construction: cap 2x covers it)
@@ -37,7 +38,8 @@ def main(argv=None):
     run_config("inner_join_capped", {"left_rows": nl, "right_rows": nr,
                                      "row_cap": 2 * nl},
                lambda l, r: inner_join_capped([l], [r], row_cap=2 * nl),
-               (lk, rk), n_rows=nl, iters=args.iters, jit=True)
+               (lk, rk), n_rows=nl, iters=args.iters, jit=True,
+               kernels="fallback")
 
 
 if __name__ == "__main__":

@@ -14,7 +14,8 @@ import sys
 import numpy as np
 
 sys.path.insert(0, ".")
-from benchmarks.common import parse_args, run_config  # noqa: E402
+from benchmarks.common import (parse_args, registry_kernels,  # noqa: E402
+                               run_config)
 
 
 def _datagen(n_sales: int, seed=0):
@@ -157,7 +158,11 @@ def main(argv=None):
     run_config("nds_q3_pipeline_capped", {"num_sales": n_sales, **caps},
                jrun, (sales, dates, items), n_rows=n_sales,
                iters=args.iters, jit=False,   # already jitted above
-               impl="capped_jit")
+               impl="capped_jit",
+               # the hand-written jnp pipeline dispatches the
+               # registry groupby inside groupby_aggregate_capped;
+               # joins/sorts call the universal lowerings directly
+               kernels=registry_kernels("groupby"))
 
     # the same query through the plan engine's capped tier (generic
     # operator DAG; materializes each join frame instead of composing

@@ -17,7 +17,8 @@ import sys
 import numpy as np
 
 sys.path.insert(0, ".")
-from benchmarks.common import parse_args, run_config  # noqa: E402
+from benchmarks.common import (parse_args, registry_kernels,  # noqa: E402
+                               run_config)
 
 
 def _datagen(n_sales: int, seed=0):
@@ -215,7 +216,11 @@ def main(argv=None):
                tuple(x for pair in tabs.values() for x in pair) + (dates,),
                n_rows=n_total, iters=args.iters,
                jit=True,    # capped static-shape tier: one XLA program
-               impl="capped_jit")
+               impl="capped_jit",
+               # the hand-written jnp pipeline dispatches the
+               # registry groupby inside groupby_aggregate_capped;
+               # joins/sorts call the universal lowerings directly
+               kernels=registry_kernels("groupby"))
 
     # plan tier, optimizer off AND on: parity asserted, rows/bytes deltas
     # on the JSONL rows (docs/optimizer.md)

@@ -17,7 +17,8 @@ import sys
 import numpy as np
 
 sys.path.insert(0, ".")
-from benchmarks.common import parse_args, run_config  # noqa: E402
+from benchmarks.common import (parse_args, registry_kernels,  # noqa: E402
+                               run_config)
 
 
 def _datagen(n_sales: int, seed=0):
@@ -202,7 +203,11 @@ def main(argv=None):
     run_config("nds_q72_pipeline_capped", {"num_sales": n, **caps}, jrun,
                tabs, n_rows=n, iters=args.iters,
                jit=False,   # already jitted above
-               impl="capped_jit")
+               impl="capped_jit",
+               # the hand-written jnp pipeline dispatches the
+               # registry groupby inside groupby_aggregate_capped;
+               # joins/sorts call the universal lowerings directly
+               kernels=registry_kernels("groupby"))
 
     # plan tier, optimizer off AND on: parity asserted, rows/bytes deltas
     # on the JSONL rows (docs/optimizer.md)

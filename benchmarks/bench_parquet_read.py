@@ -49,11 +49,18 @@ def main(argv=None):
                                               chunk["v"].data))
                 rows += chunk.num_rows
         dt = time.perf_counter() - t0
+        import jax
         print(json.dumps({"bench": "parquet_read_filter_project",
                           "axes": {"num_rows": rows,
                                    "file_mb": round(size_mb, 1)},
                           "ms": round(dt * 1e3, 1),
-                          "rows_per_s": round(rows / dt)}), flush=True)
+                          "rows_per_s": round(rows / dt),
+                          # the cross-cutting stamp rule
+                          # (tools/lint_metrics.py): raw reader
+                          # bench, no registry op dispatched
+                          "backend": jax.default_backend(),
+                          "n_devices": len(jax.devices()),
+                          "kernels": "fallback"}), flush=True)
 
 
 if __name__ == "__main__":

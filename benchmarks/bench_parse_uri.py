@@ -31,7 +31,8 @@ def main(argv=None):
     run_config("parse_uri_random", {"num_rows": n_rows},
                lambda c: parse_uri_to_protocol(c, pad_to=pad,
                                                out_pad_to=pad).data,
-               (col,), n_rows=n_rows, iters=args.iters)
+               (col,), n_rows=n_rows, iters=args.iters,
+               kernels="fallback")
 
     for hit_rate in (0, 50, 100):
         col = uri_mix(n_rows, hit_rate, seed=6)
@@ -39,7 +40,8 @@ def main(argv=None):
         run_config("parse_uri", {"num_rows": n_rows, "hit_rate": hit_rate},
                    lambda c: parse_uri_to_protocol(c, pad_to=pad,
                                                    out_pad_to=pad).data,
-                   (col,), n_rows=n_rows, iters=args.iters)
+                   (col,), n_rows=n_rows, iters=args.iters,
+                   kernels="fallback")
 
 
 if __name__ == "__main__":

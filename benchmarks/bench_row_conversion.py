@@ -8,7 +8,8 @@ rows — RowConversion.java:32-34).
 import sys
 
 sys.path.insert(0, ".")
-from benchmarks.common import parse_args, random_fixed_table, run_config  # noqa: E402
+from benchmarks.common import (parse_args, random_fixed_table,  # noqa: E402
+                               registry_kernels, run_config)
 
 CYCLE = None  # filled in main() once dtypes is importable
 
@@ -40,13 +41,15 @@ def main(argv=None):
                        {"variant": variant, "num_rows": n_rows,
                         "num_cols": n_cols, "direction": "to row"},
                        lambda t, f=to_rows: f(t)[0].children[0].data,
-                       (table,), n_rows=n_rows, iters=args.iters)
+                       (table,), n_rows=n_rows, iters=args.iters,
+                       kernels=registry_kernels("row_conversion"))
             run_config("row_conversion",
                        {"variant": variant, "num_rows": n_rows,
                         "num_cols": n_cols, "direction": "from row"},
                        lambda r, s=schema: [c.data for c in
                                             convert_from_rows(r, s).columns],
-                       (rows,), n_rows=n_rows, iters=args.iters)
+                       (rows,), n_rows=n_rows, iters=args.iters,
+                       kernels=registry_kernels("row_conversion"))
 
 
 if __name__ == "__main__":

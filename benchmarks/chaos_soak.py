@@ -50,7 +50,8 @@ def main(argv=None):
     from spark_rapids_tpu.runtime.health import HALF_OPEN
     from benchmarks.bench_nds_q3 import build_tables as q3_tables
     from benchmarks.bench_nds_q5 import build_tables as q5_tables
-    from benchmarks.nds_plans import (q3_inputs, q3_plan, q5_inputs, q5_plan)
+    from benchmarks.nds_plans import (kernels_of, q3_inputs, q3_plan,
+                                      q5_inputs, q5_plan)
 
     n = max(2000, int(30_000 * args.scale))
     sales, dates3, items = q3_tables(n, seed=7)
@@ -82,6 +83,7 @@ def main(argv=None):
             totals["degraded"] += int(res.degraded)
             emit_record("chaos_soak", {"query": q, "rows": n}, ms, n,
                         impl="plan_eager", retries=res.retries,
+                        kernels=kernels_of(res),
                         faults_injected=faults, degraded=res.degraded,
                         breaker=res.breaker["state"])
             return res

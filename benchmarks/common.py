@@ -238,6 +238,19 @@ def run_config(bench: str, axes: Dict, fn: Callable, args, *, n_rows: int,
     return emit_record(bench, axes, ms, n_rows, impl=impl, **extra)
 
 
+def registry_kernels(*op_names: str) -> Dict:
+    """Signature-independent kernel-registry choices for the ops a bench
+    dispatches through the public `ops` surface (e.g. "groupby",
+    "row_conversion") — the honest `kernels` stamp for non-plan benches
+    that still cross the registry. Benches that never dispatch a registry
+    op stamp the string "fallback" instead (bench.py's convention:
+    stamping choices the run never dispatched would misattribute); plan
+    benches stamp the executed result's per-op choices via
+    `nds_plans.kernels_of`. Enforced premerge by tools/lint_metrics.py."""
+    from spark_rapids_tpu.ops.registry import REGISTRY
+    return {op: REGISTRY.select(op, None).name for op in op_names}
+
+
 # ---- datagen ----------------------------------------------------------------
 
 def random_fixed_table(dts: Sequence, n_rows: int, seed: int = 0):

@@ -1,0 +1,114 @@
+"""Nightly resource-certifier gate (ci/nightly.sh, docs/analysis.md).
+
+Runs NDS q5 and q72 through the eager plan tier COLD then WARM under a
+fresh per-fingerprint stats store and asserts the certifier's whole
+contract on real query shapes (the fuzzer asserts it on random DAGs):
+
+- SOUNDNESS (gated): for every operator of every run, the observed row
+  count lies inside the certified ``[lo, hi]`` interval and the observed
+  eager bytes stay at or under the certified byte bound — cold and warm,
+  so a stats-driven rewrite can never escape the proof;
+- ADMISSION (gated): an executor given a 1-byte certified budget rejects
+  the plan with an operator-labelled ResourceAdmissionError BEFORE any
+  compilation (the acceptance shape of docs/analysis.md#admission);
+- TIGHTNESS (reported, never gated): the certified/observed ratio per
+  operator — median and max across the plan — emitted to JSONL per
+  (query, phase) row for trend tracking. Bounds are sound by
+  construction; this trajectory shows whether they stay USEFUL (a
+  certified join bound drifting to 1000x observed is admission noise).
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from benchmarks.common import emit_record, parse_args        # noqa: E402
+from benchmarks.nds_plans import (kernels_of, q5_inputs,     # noqa: E402
+                                  q5_plan, q72_inputs, q72_plan)
+
+
+def _certify(res, inputs):
+    from spark_rapids_tpu.analysis import certify
+    from spark_rapids_tpu.analysis.footprint import table_metadata
+    dts, nul = table_metadata(inputs)
+    return certify(res.plan,
+                   bound={n: tuple(t.names) for n, t in inputs.items()},
+                   bound_rows={n: t.num_rows for n, t in inputs.items()},
+                   input_dtypes=dts, input_nullable=nul)
+
+
+def _check(name, phase, res, cert):
+    """Gated soundness (the single-sourced inequality — the fuzzer's
+    property 5 runs the same `check_observed`) + reported tightness."""
+    from spark_rapids_tpu.analysis.footprint import check_observed
+    bad = check_observed(cert, res)
+    assert bad is None, f"{name}/{phase}: certifier unsound — {bad}"
+    ratios = sorted(
+        b.rows_hi / m.rows_out
+        for lbl, m in res.metrics.items()
+        for b in (cert.by_label[lbl],)
+        if b.rows_hi is not None and m.rows_out > 0)
+    if not ratios:
+        return {"tightness_rows_median": None, "tightness_rows_max": None}
+    return {"tightness_rows_median":
+            round(ratios[len(ratios) // 2], 2),
+            "tightness_rows_max": round(ratios[-1], 2)}
+
+
+def _run(name, plan, inputs, n_rows):
+    from spark_rapids_tpu.plan import PlanExecutor
+    from spark_rapids_tpu.plan import stats as stats_mod
+    from spark_rapids_tpu.analysis.footprint import ResourceAdmissionError
+
+    # admission gate: a 1-byte budget cannot admit anything — the reject
+    # must name an operator and land before any compilation
+    try:
+        PlanExecutor(mode="capped", cert_budget=1).execute(plan,
+                                                           dict(inputs))
+        raise SystemExit(f"{name}: over-budget plan was admitted")
+    except ResourceAdmissionError as e:
+        v = e.violations[0]
+        assert v.invariant == "footprint.over-budget" and "#" in v.node, \
+            f"{name}: admission diagnostic lacks the operator label: {e}"
+
+    results = {}
+    # path="": a genuinely cold store, never the persisted operator file
+    store = stats_mod.StatsStore(capacity=32, path="")
+    for phase in ("cold", "warm"):
+        with stats_mod.scoped_store(store):
+            ex = PlanExecutor(mode="eager")
+            t0 = time.perf_counter()
+            res = ex.execute(plan, dict(inputs))
+            ms = (time.perf_counter() - t0) * 1e3
+            results[phase] = res.compact().to_pydict()
+            cert = _certify(res, inputs)
+            tight = _check(name, phase, res, cert)
+            emit_record(
+                f"footprint_{name}", {"phase": phase}, ms, n_rows,
+                impl="plan_eager", kernels=kernels_of(res),
+                cert_peak_bytes=cert.peak_bytes_hi,
+                cert_root_rows_hi=cert.root.rows_hi,
+                cert_unbounded_ops=len(cert.unbounded), **tight)
+    assert results["cold"] == results["warm"], \
+        f"{name}: cold/warm parity broke under the certifier"
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    n = max(int(100_000 * args.scale), 5_000)
+
+    from benchmarks.bench_nds_q5 import build_tables as bt5
+    from benchmarks.bench_nds_q72 import build_tables as bt72
+
+    q5_in = q5_inputs(*bt5(n, seed=7))
+    _run("q5", q5_plan(), q5_in,
+         n_rows=sum(t.num_rows for t in q5_in.values()))
+
+    q72_in = q72_inputs(*bt72(n, seed=9))
+    _run("q72", q72_plan(), q72_in,
+         n_rows=sum(t.num_rows for t in q72_in.values()))
+    print("footprint certifier OK", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -229,7 +229,7 @@ def _plan_variants_static(bench, axes, plan, inputs, n_rows, iters, caps,
         recs.append(run_config(
             bench, dict(axes), prun, (), n_rows=n_rows, iters=iters,
             jit=False, impl="plan_capped", optimizer=label,
-            rules_fired=rules, **extra))
+            rules_fired=rules, kernels=kernels_of(res), **extra))
     assert results["on"] == results["off"], \
         f"{bench}: optimizer changed the result"
     return recs
@@ -345,6 +345,7 @@ def run_plan_distributed(bench: str, axes: dict, plan, inputs, *,
     rec = run_config(
         bench, dict(axes), prun, (), n_rows=n_rows, iters=iters,
         jit=False, impl="plan_distributed", mesh_axis=mesh_axis,
+        kernels=kernels_of(res),
         exchange_bytes=sum(m.exchange_bytes for m in res.metrics.values()),
         mesh_devices=int(mesh.shape[mesh_axis]),
         exchanges_planned=opt.get("exchanges", {}),

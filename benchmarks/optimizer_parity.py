@@ -21,7 +21,7 @@ import sys
 sys.path.insert(0, ".")
 
 from benchmarks.common import emit_record, parse_args        # noqa: E402
-from benchmarks.nds_plans import (q3_inputs, q3_plan,        # noqa: E402
+from benchmarks.nds_plans import (kernels_of, q3_inputs, q3_plan,  # noqa: E402
                                   q5_inputs, q5_plan, q23_inputs, q23_plan,
                                   q72_inputs, q72_plan, run_plan_variants)
 
@@ -85,7 +85,8 @@ def main(argv=None):
         # the measured (static) run, not the process default at exit
         emit_record("optimizer_fingerprint_reuse", {"num_rows": n_rows},
                     res.wall_ms, n_rows, impl="plan_capped",
-                    optimizer="on", jit_cache_hits=res.jit_cache_hits)
+                    optimizer="on", jit_cache_hits=res.jit_cache_hits,
+                    kernels=kernels_of(res))
     print("optimizer parity OK", file=sys.stderr)
 
 

@@ -40,9 +40,14 @@ def main(argv=None):
     summary = run_corpus(range(extra.seed0, extra.seed0 + count),
                          execute=True, max_ops=extra.max_ops)
     ms = (time.perf_counter() - t0) * 1e3
+    from spark_rapids_tpu.ops.registry import REGISTRY
     emit_record("plan_fuzz", {"seed0": extra.seed0, "count": count,
                               "max_ops": extra.max_ops},
                 ms, n_rows=summary["cases"], impl="plan_eager",
+                # the sweep's signature-independent registry floor:
+                # exact on CPU (accelerator kernels never auto-pick),
+                # the conservative floor on device
+                kernels=REGISTRY.summary(),
                 fuzz_cases=summary["cases"],
                 fuzz_executed=summary["executed"],
                 fuzz_failures=len(summary["failures"]),

@@ -25,6 +25,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 import numpy as np
 
 from benchmarks.common import emit_record, parse_args
+from benchmarks.nds_plans import kernels_of
 
 N_ROWS = 400_000
 ROW_GROUP = 25_000          # 16 row groups at full scale
@@ -109,6 +110,7 @@ def main() -> int:
             results[mode] = (res, scan_m)
             emit_record("streaming_scan", {"mode": mode, "rows": n_rows},
                         ms, n_rows, impl=f"plan_{mode}",
+                        kernels=kernels_of(res),
                         io_row_groups_pruned=scan_m.io_row_groups_pruned,
                         io_bytes_skipped=scan_m.io_bytes_skipped,
                         io_overlap_ms=scan_m.io_overlap_ms,

@@ -56,11 +56,22 @@ JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 # whenever a TPU backend is present; emits per-kernel JSONL rows with the
 # `kernels` stamp
 JAX_PLATFORMS=cpu python benchmarks/kernel_bench.py --scale 0.05 --cpu
+# resource-certifier gate (docs/analysis.md): NDS q5/q72 eager, cold and
+# warm under a fresh stats store — certified [lo,hi] row bounds hold for
+# every operator (bytes too, eager tier), a 1-byte budget rejects at
+# admission with the operator named, and the bound-tightness ratio
+# (certified/observed, median + max) is emitted to JSONL — reported, not
+# gated: bounds are sound by construction, this tracks whether they stay
+# USEFUL
+JAX_PLATFORMS=cpu python benchmarks/footprint_bench.py --scale 0.1 --cpu
 # deep plan fuzz (docs/analysis.md): a seeded sweep of >=200 random plans
 # over all 11 operator kinds — static verification (authored + optimized,
-# per-rule re-validation), no optimizer fall-backs, and small-plan eager
-# parity optimized-vs-unoptimized (error parity included); emits one
-# JSONL summary row, and any failing seed replays standalone via
+# per-rule re-validation), no optimizer fall-backs, small-plan eager
+# parity optimized-vs-unoptimized (error parity included), cold-vs-warm
+# adaptive parity, and certifier soundness + monotonicity (property 5:
+# observed rows/bytes inside certified bounds on every run, optimized
+# root bound <= authored); emits one JSONL summary row, and any failing
+# seed replays standalone via
 # `python -m spark_rapids_tpu.analysis.fuzz --start <seed> --count 1 -v`
 JAX_PLATFORMS=cpu python benchmarks/plan_fuzz.py --seed0 1000 --count 200 --cpu
 ./ci/fuzz-test.sh

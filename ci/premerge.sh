@@ -8,12 +8,19 @@ python -c "import spark_rapids_tpu; print('import ok:', spark_rapids_tpu.__name_
 # JAX-hazard linter (tools/lint_hazards.py, docs/analysis.md): AST-checks
 # the known hazard patterns (self capture in jit closure caches, host
 # sync on traced values, tracer branches, env reads outside config.py,
-# nondeterministic iteration feeding fingerprints); vetted exceptions
-# live in tools/lint_hazards_allowlist.txt with one-line justifications
+# nondeterministic iteration feeding fingerprints, inconsistent lock
+# guards on shared-state classes, unguarded module-global mutation);
+# vetted exceptions live in tools/lint_hazards_allowlist.txt with
+# one-line justifications — STALE entries fail the run, prune them
 python tools/lint_hazards.py spark_rapids_tpu
+# bench-JSONL stamp linter (tools/lint_metrics.py): every emit_record/
+# run_config call site stamps `kernels`, every raw JSONL record carries
+# backend/n_devices/kernels — the ROADMAP cross-cutting rule, enforced
+python tools/lint_metrics.py
 # fixed fuzz corpus (analysis/fuzz.py): 24 seeded random plans covering
 # all 11 node kinds — verify + optimize (per-rule re-validation) + eager
-# optimized-vs-unoptimized parity; the nightly runs the deep sweep
+# optimized-vs-unoptimized parity + cold-vs-warm adaptive parity +
+# certifier soundness/monotonicity; the nightly runs the deep sweep
 JAX_PLATFORMS=cpu python -m spark_rapids_tpu.analysis.fuzz --start 0 --count 24 --cpu
 python -m pytest tests/ -x -q
 python benchmarks/run_all.py --scale 0.002 --iters 2 --cpu

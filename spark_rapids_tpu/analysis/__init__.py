@@ -15,6 +15,13 @@ check that gates every optimizer rule, executor tier and plan:
   conditions. Wired as the builder's validation backend, a debug-mode
   pre-execution gate (`SPARK_RAPIDS_TPU_VERIFY_PLANS`, on in tests), and
   the optimizer's per-rule fall-back diagnostic.
+- `footprint`: the static resource certifier — an abstract interpreter
+  propagating sound `[lo, hi]` row intervals and byte footprints
+  (columnar widths, validity planes, join/aggregate working sets,
+  exchange payloads) per operator, consumed by the executor's admission
+  gate, the optimizer's broadcast byte-legality proof, and the capped
+  tier's cold-run cap seeding. Its soundness inequality (certified hi >=
+  observed, per op) is fuzz property 5 and a nightly NDS gate.
 - `fuzz`: the property-based plan fuzzer — a seeded random DAG generator
   over all 11 operator kinds whose cases must verify, optimize cleanly,
   and (being small) execute with optimized-vs-unoptimized eager parity.
@@ -23,10 +30,14 @@ check that gates every optimizer rule, executor tier and plan:
 The AST-level sibling is `tools/lint_hazards.py`: the codebase linter for
 the known JAX hazard patterns (self capture in jit closure caches,
 host-sync on traced values, tracer branches, env reads outside config.py,
-nondeterministic iteration feeding fingerprints).
+nondeterministic iteration feeding fingerprints, unlocked shared-state
+mutation), plus `tools/lint_metrics.py` for the bench-JSONL stamp rule.
 """
+from .footprint import (ResourceAdmissionError, ResourceCert, certify,
+                        certify_nodes)
 from .verifier import (PlanVerificationError, VerifyReport, Violation,
                        verify, verify_rewrite)
 
 __all__ = ["PlanVerificationError", "VerifyReport", "Violation",
-           "verify", "verify_rewrite"]
+           "verify", "verify_rewrite", "ResourceAdmissionError",
+           "ResourceCert", "certify", "certify_nodes"]

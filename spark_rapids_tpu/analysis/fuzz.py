@@ -5,7 +5,7 @@ machine-GENERATES the plans to check them on. A `FuzzCase` is a seeded
 random operator DAG (Scan, Filter, Project, FusedSelect, HashJoin,
 HashAggregate, Sort, TopK, Limit, Union, Exchange — the full node set,
 including the optimizer-produced kinds, authored directly) plus the bound
-tables it runs over. Every case must satisfy three properties:
+tables it runs over. Every case must satisfy five properties:
 
 1. the authored plan VERIFIES (generator correctness — schema, typing and
    pruning layers clean);
@@ -18,7 +18,14 @@ tables it runs over. Every case must satisfy three properties:
 4. the plan executed TWICE under a fresh per-case stats store
    (plan/stats.py) agrees bit-for-bit between the cold and warm runs,
    error class included — adaptivity (observed-cardinality build sides,
-   cap seeding, kernel tie-breaks) may change *how*, never *what*.
+   cap seeding, kernel tie-breaks) may change *how*, never *what*;
+5. the resource certifier (analysis/footprint.py) is SOUND and
+   MONOTONE: for every operator of every successful execution —
+   unoptimized, optimized, cold AND warm — the observed row count lies
+   inside the certified `[lo, hi]` interval and the observed eager
+   bytes stay at or under the certified byte bound; and the optimizer
+   may only keep or tighten the root's certified bounds (a rewrite
+   that loosens a proof is a bug even when results agree).
 
 Determinism is a contract: `gen_case(seed)` builds the same DAG (same
 fingerprint) and the same table bytes every time — `random.Random(seed)`
@@ -65,13 +72,18 @@ class FuzzResult:
     # the stats store — adaptivity may change HOW, never WHAT (errors
     # included)
     adaptive_parity: Optional[bool] = None
+    # property 5 (docs/analysis.md): certifier soundness (observed rows/
+    # bytes inside the certified bounds, every op, every run) and
+    # monotonicity (optimized root bound <= authored root bound)
+    cert_sound: Optional[bool] = None
     error: Optional[str] = None
 
     @property
     def ok(self) -> bool:
         return (self.verified and self.optimized_verified
                 and self.error is None and self.parity is not False
-                and self.adaptive_parity is not False)
+                and self.adaptive_parity is not False
+                and self.cert_sound is not False)
 
 
 # ---- deterministic relation/expression generation ---------------------------
@@ -305,8 +317,50 @@ def gen_case(seed: int, *, max_ops: int = 8,
 
 # ---- properties -------------------------------------------------------------
 
+def _cert_soundness(case: FuzzCase, res, bound, input_dtypes,
+                    input_nullable) -> Optional[str]:
+    """Property 5's per-run half: certify the EXECUTED plan and hold
+    every operator's observed metrics inside the certified bounds via
+    the single-sourced inequality (`footprint.check_observed` — the
+    nightly gate runs the SAME check). Returns the first violation as a
+    string, None when sound."""
+    from .footprint import certify, check_observed
+    cert = certify(res.plan, bound=bound,
+                   bound_rows={n: t.num_rows
+                               for n, t in case.tables.items()},
+                   input_dtypes=input_dtypes,
+                   input_nullable=input_nullable)
+    return check_observed(cert, res)
+
+
+def _cert_monotonicity(case: FuzzCase, opt, bound, input_dtypes,
+                       input_nullable) -> Optional[str]:
+    """Property 5's rewrite half: the optimized plan's certified ROOT
+    bounds must not exceed the authored plan's — every rule preserves or
+    shrinks the relation it proves things about, so a looser optimized
+    proof means a certifier or rule bug."""
+    from .footprint import certify
+    kw = dict(bound=bound,
+              bound_rows={n: t.num_rows for n, t in case.tables.items()},
+              input_dtypes=input_dtypes, input_nullable=input_nullable)
+    a = certify(case.plan, **kw).root
+    o = certify(opt, **kw).root
+    if a.rows_hi is not None and (
+            o.rows_hi is None or o.rows_hi > a.rows_hi):
+        return (f"optimized root rows hi {o.rows_hi} exceeds authored "
+                f"{a.rows_hi}")
+    # None-after-finite is a LOOSENED proof, same as the rows branch: a
+    # rewrite that makes the root's bytes uncertifiable weakens the
+    # admission and broadcast-legality gates even when results agree
+    if a.out_bytes_hi is not None and (
+            o.out_bytes_hi is None or o.out_bytes_hi > a.out_bytes_hi):
+        return (f"optimized root bytes hi {o.out_bytes_hi} exceeds "
+                f"authored {a.out_bytes_hi}")
+    return None
+
+
 def run_case(case: FuzzCase, *, execute: bool = True) -> FuzzResult:
-    """Check the three fuzz properties on one case (see module doc).
+    """Check the five fuzz properties on one case (see module doc).
     Never raises for a property FAILURE (the result carries it); raises
     only on generator bugs like unbuildable plans."""
     from ..plan.executor import PlanExecutor, _input_has_floats
@@ -341,11 +395,24 @@ def run_case(case: FuzzCase, *, execute: bool = True) -> FuzzResult:
         res.error = f"optimized plan failed verify: {rep.violations[0]}"
         return res
 
+    # property 5 (rewrite half): the optimizer may only keep or tighten
+    # the root's certified bounds
+    from .footprint import table_metadata
+    _, input_nullable = table_metadata(case.tables)
+    mono = _cert_monotonicity(case, opt, bound, input_dtypes,
+                              input_nullable)
+    if mono is not None:
+        res.cert_sound = False
+        res.error = f"cert monotonicity broke: {mono}"
+        return res
+    res.cert_sound = True
+
     if not execute:
         return res
     res.executed = True
     from ..plan import stats as stats_mod
     outs = {}
+    cert_runs = []               # successful PlanResults for property 5
     # properties 1-3 measure the STATIC engine: scope adaptivity off, or
     # a premerge/nightly corpus run (no pytest conftest, stats default
     # ON) would record seed N's plans into the process-default store and
@@ -357,6 +424,7 @@ def run_case(case: FuzzCase, *, execute: bool = True) -> FuzzResult:
             try:
                 r = ex.execute(case.plan, dict(case.tables))
                 outs[optimized] = ("ok", r.compact().to_pydict())
+                cert_runs.append(r)
             except Exception as e:     # parity includes error parity
                 outs[optimized] = ("err", type(e).__name__)
     res.parity = outs[False] == outs[True]
@@ -381,12 +449,25 @@ def run_case(case: FuzzCase, *, execute: bool = True) -> FuzzResult:
             try:
                 r = ex.execute(case.plan, dict(case.tables))
                 runs.append(("ok", r.compact().to_pydict()))
+                cert_runs.append(r)
             except Exception as e:
                 runs.append(("err", type(e).__name__))
     res.adaptive_parity = runs[0] == runs[1]
     if not res.adaptive_parity:
         res.error = (f"adaptive parity broke: cold={runs[0]!r} "
                      f"warm={runs[1]!r}")
+        return res
+
+    # property 5 (soundness half): every successful run — unoptimized,
+    # optimized, cold and warm — stays inside the certified bounds of
+    # ITS executed plan (cold and warm may have rewritten differently)
+    for r in cert_runs:
+        bad = _cert_soundness(case, r, bound, input_dtypes,
+                              input_nullable)
+        if bad is not None:
+            res.cert_sound = False
+            res.error = f"cert soundness broke: {bad}"
+            return res
     return res
 
 

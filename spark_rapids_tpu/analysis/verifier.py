@@ -54,7 +54,8 @@ from ..plan.nodes import (Exchange, Filter, FusedSelect, HashAggregate,
                           Project, Scan, Sort, TopK, Union)
 
 __all__ = ["Violation", "VerifyReport", "PlanVerificationError",
-           "verify", "verify_rewrite", "check_build", "resolve_schemas"]
+           "verify", "verify_rewrite", "check_build", "resolve_schemas",
+           "column_types"]
 
 
 # ---- error vocabulary -------------------------------------------------------
@@ -322,9 +323,13 @@ def _check_predicate(pred: Expr, coltypes, node, report: VerifyReport):
                    "capped tier's alive set")
 
 
-def _check_types(nodes, schemas, input_dtypes, report: VerifyReport):
+def _check_types(nodes, schemas, input_dtypes, report: VerifyReport
+                 ) -> Dict[int, Dict[str, Optional[dtypes.DType]]]:
     """Walk node dtypes bottom-up; unknown columns stay unknown and never
-    flag. `input_dtypes` maps scan source -> {column: DType}."""
+    flag. `input_dtypes` maps scan source -> {column: DType}. Returns the
+    per-node column-dtype map — the resource certifier
+    (analysis/footprint.py) reuses this exact propagation for its byte
+    widths, so typing and sizing can never disagree about a column."""
     types: Dict[int, Dict[str, Optional[dtypes.DType]]] = {}
     for node in nodes:
         if id(node) not in schemas:
@@ -397,6 +402,16 @@ def _check_types(nodes, schemas, input_dtypes, report: VerifyReport):
             continue
         # Sort/TopK/Limit/Exchange: pass-through
         types[id(node)] = dict(kids[0]) if kids else {}
+    return types
+
+
+def column_types(nodes, schemas, input_dtypes
+                 ) -> Dict[int, Dict[str, Optional[dtypes.DType]]]:
+    """Public face of the typing walk for non-gating consumers: node-id ->
+    {column name -> DType or None (unknown)} under the same bottom-up
+    semantics the typing layer verifies. Violations found along the way are
+    discarded here — callers that want them gate through verify()."""
+    return _check_types(nodes, schemas, input_dtypes, VerifyReport())
 
 
 # ---- layer 3: pruning-predicate legality ------------------------------------

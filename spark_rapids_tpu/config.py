@@ -26,6 +26,10 @@ equivalents, all read at use time (not import time) so tests can monkeypatch:
 | SPARK_RAPIDS_TPU_IO_PREFETCH     | 2    | streaming-scan prefetch depth (chunks decoded ahead); 0 = decode inline |
 | SPARK_RAPIDS_TPU_IO_CHUNK_ROWS   | 0    | streaming-scan morsel row bound (0 = one chunk per row group) |
 | SPARK_RAPIDS_TPU_BROADCAST_ROWS  | 8192 | distributed tier: estimated build-side rows at or below which exchange_planning picks a broadcast join over a shuffle |
+| SPARK_RAPIDS_TPU_BROADCAST_BYTES | 64 MiB | distributed tier: certified build-side byte bound (analysis/footprint.py) above which exchange_planning refuses a broadcast even when the row heuristic qualifies — broadcast legality as a proven byte bound |
+| SPARK_RAPIDS_TPU_CERT_BUDGET_BYTES | 0 | static resource certifier (analysis/footprint.py): device byte budget the admission gate compares certified per-operator residency hi-bounds against; 0 disables admission sizing |
+| SPARK_RAPIDS_TPU_CERT_ADMISSION  | reject | what an over-budget certified plan does at admission: reject (raise ResourceAdmissionError naming the operator, before any compilation) / degrade (run on the CPU tier) |
+| SPARK_RAPIDS_TPU_CERT_SEED       | on   | capped tier: tighten cold-run starting capacities to the certified hi-bound and ceiling the escalation ladder at it (active only with the stats store on — stats off stays byte-identical static) |
 | SPARK_RAPIDS_TPU_DIST_SLACK      | 2.0  | distributed tier: initial per-bucket slack factor for hash/range exchanges (grows geometrically on overflow) |
 | SPARK_RAPIDS_TPU_VERIFY_PLANS    | 0    | static plan verifier gate (analysis/verifier.py): 1 verifies every plan pre-execution and every optimizer rule's output; on in tests (conftest), off in production |
 | SPARK_RAPIDS_TPU_STATS           | on   | per-fingerprint operator-stats store (plan/stats.py, docs/adaptive.md): observed cardinalities drive join build sides / exchange modes, cap seeding, chunk sizing, and kernel tie-breaks; "off" restores fully static decisions |
@@ -171,6 +175,55 @@ def broadcast_rows() -> int:
     autoBroadcastJoinThreshold. Estimates come from bound tables or
     `est_rows` scan hints."""
     return _int_env("SPARK_RAPIDS_TPU_BROADCAST_ROWS", 8192)
+
+
+def broadcast_bytes() -> int:
+    """Distributed tier: the PROVEN byte bound broadcast-join legality
+    requires (analysis/footprint.py, docs/analysis.md) — a build side
+    whose certified hi-bound exceeds this never broadcasts, whatever the
+    row estimate said (estimates are guesses; replicating a mis-estimated
+    relation onto every peer is the failure mode this gate closes). Sides
+    the certifier cannot bound (strings, unbound scans) fall back to the
+    row heuristic alone. Default 64 MiB — roomy, the row threshold stays
+    the cost heuristic; this is the legality ceiling."""
+    return _int_env("SPARK_RAPIDS_TPU_BROADCAST_BYTES", 64 << 20)
+
+
+def cert_budget_bytes() -> int:
+    """Static-certifier admission budget (analysis/footprint.py): when
+    non-zero, PlanExecutor.execute() compares every operator's certified
+    residency hi-bound against this before any compilation and applies
+    `cert_admission()`. 0 (default) disables admission sizing — the
+    capped tier's escalation/OOM machinery remains the fallback."""
+    return max(0, _int_env("SPARK_RAPIDS_TPU_CERT_BUDGET_BYTES", 0))
+
+
+def cert_admission() -> str:
+    """Over-budget policy for the certifier's admission gate: "reject"
+    raises ResourceAdmissionError naming the offending operator (the
+    serving-layer posture: fail fast, before compilation); "degrade"
+    finishes the plan on the CPU tier (the device budget does not bind
+    there). Same strict-typo policy as the kernel selectors."""
+    v = os.environ.get("SPARK_RAPIDS_TPU_CERT_ADMISSION", "reject")
+    if v not in ("reject", "degrade"):
+        raise ValueError(
+            f"SPARK_RAPIDS_TPU_CERT_ADMISSION={v!r}: expected reject or "
+            "degrade")
+    return v
+
+
+def cert_seed() -> bool:
+    """Capped tier: whether cold adaptive runs tighten starting
+    capacities to the certified hi-bound and ceiling the escalation
+    ladder at it (analysis/footprint.py, docs/adaptive.md). Only active
+    when a stats store is (SPARK_RAPIDS_TPU_STATS=on or a scoped store)
+    — with stats off the capped tier stays byte-identical static. Same
+    strict-typo policy as the kernel selectors."""
+    v = os.environ.get("SPARK_RAPIDS_TPU_CERT_SEED", "on")
+    if v not in ("on", "off"):
+        raise ValueError(
+            f"SPARK_RAPIDS_TPU_CERT_SEED={v!r}: expected on or off")
+    return v == "on"
 
 
 def dist_slack() -> float:

@@ -212,6 +212,11 @@ class suppressed:
 _active: Optional[FaultInjector] = None
 _saved_ops: Dict[str, Callable] = {}
 _saved_budget_methods: Dict[str, Callable] = {}
+# install/uninstall swap module-global interception state (the shims AND
+# the saved originals); two racing installs would save each other's shims
+# as "originals" and uninstall could never restore the real ops (the
+# unguarded-module-global-mutation lint rule machine-checks this)
+_install_lock = threading.Lock()
 
 
 def active() -> Optional[FaultInjector]:
@@ -237,6 +242,11 @@ def install(config_path: Optional[str] = None) -> FaultInjector:
     Idempotent per-process like the reference's cuInit-time load; call
     uninstall() first to swap interception points.
     """
+    with _install_lock:
+        return _install_locked(config_path)
+
+
+def _install_locked(config_path: Optional[str]) -> FaultInjector:
     global _active
     from . import config as _config
     path = config_path or _config.faultinj_config_path()
@@ -280,6 +290,11 @@ def install(config_path: Optional[str] = None) -> FaultInjector:
 
 def uninstall() -> None:
     """Remove interception and restore the original callables."""
+    with _install_lock:
+        _uninstall_locked()
+
+
+def _uninstall_locked() -> None:
     global _active
     _active = None
     if _saved_ops:

@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import dataclasses
 import importlib
+import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -136,38 +137,50 @@ class KernelRegistry:
         # dispatch path, so the strict-typo scan (provider _ensure + name
         # lookup per entry) runs once per distinct knob value, not per call
         self._ov_validated: Optional[Tuple[Tuple[str, str], ...]] = None
+        # the process-global REGISTRY is dispatched from every executor
+        # thread; RLock because provider imports under _ensure re-enter
+        # register() on the same thread. Mutations of the catalog and the
+        # override memo hold it (machine-checked by the lint_hazards
+        # lock-discipline rule); lock-free reads in select() see either
+        # the pre- or post-registration list, both complete.
+        self._lock = threading.RLock()
 
     # ---- registration (provider modules, at import time) -------------------
     def register(self, op: str, name: str, fn: Optional[Callable] = None, *,
                  backends: Sequence[str] = ("*",),
                  supports: Optional[Callable] = None,
                  fallback: bool = False) -> Kernel:
-        ks = self._ops.setdefault(op, [])
-        if any(k.name == name for k in ks):
-            raise ValueError(f"kernel {name!r} already registered for {op!r}")
-        if fallback:
-            if any(k.fallback for k in ks):
-                raise ValueError(f"{op!r} already has a fallback kernel")
-            if supports is not None:
+        with self._lock:
+            ks = self._ops.setdefault(op, [])
+            if any(k.name == name for k in ks):
                 raise ValueError(
-                    f"{op!r}/{name!r}: a fallback kernel must support every "
-                    "signature (that is what makes decline safe)")
-        k = Kernel(op=op, name=name, fn=fn, backends=tuple(backends),
-                   supports=supports, fallback=fallback)
-        ks.append(k)
-        return k
+                    f"kernel {name!r} already registered for {op!r}")
+            if fallback:
+                if any(k.fallback for k in ks):
+                    raise ValueError(f"{op!r} already has a fallback kernel")
+                if supports is not None:
+                    raise ValueError(
+                        f"{op!r}/{name!r}: a fallback kernel must support "
+                        "every signature (that is what makes decline safe)")
+            k = Kernel(op=op, name=name, fn=fn, backends=tuple(backends),
+                       supports=supports, fallback=fallback)
+            ks.append(k)
+            return k
 
     def _ensure(self, op: str) -> None:
         if op in self._ops:
             return
-        mod = _PROVIDERS.get(op)
-        if mod is None:
-            raise ValueError(
-                f"unknown kernel op {op!r} (known: "
-                f"{sorted(set(self._ops) | set(_PROVIDERS))})")
-        importlib.import_module(mod)
-        if op not in self._ops:
-            raise RuntimeError(f"provider {mod} did not register {op!r}")
+        with self._lock:
+            if op in self._ops:
+                return
+            mod = _PROVIDERS.get(op)
+            if mod is None:
+                raise ValueError(
+                    f"unknown kernel op {op!r} (known: "
+                    f"{sorted(set(self._ops) | set(_PROVIDERS))})")
+            importlib.import_module(mod)
+            if op not in self._ops:
+                raise RuntimeError(f"provider {mod} did not register {op!r}")
 
     def ops(self) -> Tuple[str, ...]:
         return tuple(sorted(set(self._ops) | set(_PROVIDERS)))
@@ -191,7 +204,8 @@ class KernelRegistry:
                     f"SPARK_RAPIDS_TPU_KERNELS: unknown kernel {name!r} for "
                     f"{op!r} (have "
                     f"{[k.name for k in self._ops[op]]})")
-        self._ov_validated = key
+        with self._lock:
+            self._ov_validated = key
         return ov
 
     @staticmethod

@@ -43,18 +43,51 @@ def _grown(caps: Dict, grow: float) -> Dict:
 
 
 def auto_retry_overflow(attempt: Callable[..., Tuple], caps: Dict,
-                        max_attempts: int = 6, grow: float = 2.0):
+                        max_attempts: int = 6, grow: float = 2.0,
+                        ceil: Dict = None):
     """Run `attempt(**caps)` until its overflow flag (last element of the
     result tuple) clears, growing every capacity geometrically.
 
+    `ceil` (per-capacity upper bounds — the resource certifier's sound
+    hi-bounds, analysis/footprint.py) clamps the growth: escalating past
+    a PROVEN bound is wasted memory, so a grown capacity stops at its
+    ceiling. The ceiling is advisory, never load-bearing for progress: if
+    an attempt that ran with a clamped capacity still overflows, the
+    bound was wrong for this run (a certifier bug — soundness says this
+    cannot happen) and the ceiling is dropped, restoring the pure
+    geometric ladder rather than turning a recoverable overflow into a
+    CapacityOverflowError.
+
     Returns (result_tuple, final_caps). The overflow check is a host sync —
     this is a driver-level loop by design, like the plugin's catch-retry."""
+    ceil = dict(ceil or {})
+    clamped_last = False
     for i in range(max_attempts):
         out = attempt(**caps)
         if not bool(jnp.any(out[-1])):
             return out, caps
+        if clamped_last:
+            ceil = {}           # distrust: a clamped attempt overflowed
+            clamped_last = False
         if i + 1 < max_attempts:
-            caps = _grown(caps, grow)
+            grown = _grown(caps, grow)
+            if ceil:
+                capped = {k: max(caps[k], min(v, ceil[k]))
+                          if k in ceil and isinstance(v, int) else v
+                          for k, v in grown.items()}
+                if capped == caps:
+                    # the ceiling blocks ALL growth: re-attempting
+                    # byte-identical caps would deterministically
+                    # overflow again, burning a ladder rung for nothing
+                    # — drop the (evidently wrong) ceiling NOW and
+                    # regrow, preserving the full geometric ladder
+                    ceil = {}
+                    caps = grown
+                else:
+                    clamped_last = capped != grown
+                    caps = capped
+            else:
+                caps = grown
     raise CapacityOverflowError(
         f"overflow persisted after {max_attempts} attempts; final caps {caps}")
 

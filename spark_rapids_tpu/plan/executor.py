@@ -351,6 +351,9 @@ class PlanResult:
         #                               compiled-program reuses this execute
         self.optimizer = None         # OptimizeReport.to_dict() when the
         #                               optimizer ran (set by execute())
+        self.cert = None              # analysis/footprint.ResourceCert for
+        #                               the executed plan (set by execute();
+        #                               None when the certifier declined)
 
     def compact(self) -> Table:
         """Live rows only (identity in the eager tier)."""
@@ -370,7 +373,8 @@ class PlanResult:
                               attempts=self.attempts, caps=self.caps,
                               degraded=self.degraded, breaker=self.breaker,
                               optimizer=self.optimizer,
-                              jit_cache_hits=self.jit_cache_hits)
+                              jit_cache_hits=self.jit_cache_hits,
+                              cert=self.cert)
 
 
 class _CappedRel:
@@ -396,7 +400,8 @@ class PlanExecutor:
                  block_per_op: bool = True,
                  health=None,
                  degrade: Optional[str] = None,
-                 optimize: Optional[bool] = None):
+                 optimize: Optional[bool] = None,
+                 cert_budget: Optional[int] = None):
         if mode not in ("eager", "capped"):
             raise ValueError(f"unknown executor mode {mode!r}")
         # mesh + capped is checked PER PLAN in execute(): only a plan that
@@ -423,8 +428,17 @@ class PlanExecutor:
         # SPARK_RAPIDS_TPU_OPTIMIZER=off or optimize=False disables
         self.optimize = (config.optimizer_enabled() if optimize is None
                          else bool(optimize))
+        # admission-time footprint budget (analysis/footprint.py): a plan
+        # whose certified per-operator residency hi-bound exceeds this is
+        # rejected (or degraded, per SPARK_RAPIDS_TPU_CERT_ADMISSION)
+        # before any compilation. None defers to the
+        # SPARK_RAPIDS_TPU_CERT_BUDGET_BYTES knob; 0 disables.
+        self.cert_budget = cert_budget
         self._opt_cache = _LruDict(64)  # (root, bound sig) -> (plan, schemas,
         #                                 report): one rewrite per binding
+        self._cert_cache = _LruDict(64)  # (root, binding sig) ->
+        #                                 ResourceCert: one certify walk
+        #                                 per binding, not per execute
         self._verify_cache = _LruDict(128)  # passed pre-execution-gate
         #                                 verdicts: repeat executions of a
         #                                 cached (plan, binding) rewrite
@@ -488,12 +502,40 @@ class PlanExecutor:
         # plan's fingerprint (so warm cap seeding survives a build-side
         # flip via the global cap keys)
         source_fp = authored.fingerprint
-        if self.session is not None:
-            from ..runtime.admission import active_session
-            with active_session(self.session):
-                res = self._execute(plan, inputs, schemas, source_fp)
-        else:
-            res = self._execute(plan, inputs, schemas, source_fp)
+        # static resource certifier (analysis/footprint.py): sound
+        # per-operator [lo, hi] row and byte bounds over the plan about
+        # to run — stamped on the result, consulted by the capped tier's
+        # cold-run cap seeding, and compared against the device budget
+        # BEFORE any compilation when one is configured
+        cert = self._certify(plan, inputs, bound)
+        res = None
+        budget = (self.cert_budget if self.cert_budget is not None
+                  else config.cert_budget_bytes())
+        if budget and cert is not None:
+            violations = cert.over_budget(budget)
+            if violations:
+                from ..analysis.footprint import ResourceAdmissionError
+                if config.cert_admission() == "reject":
+                    raise ResourceAdmissionError(
+                        violations, "admission gate: certified footprint "
+                        f"exceeds the {budget} B device budget")
+                # degrade: the device budget does not bind on the CPU
+                # tier — run the whole plan there, same machinery as a
+                # breaker trip, without touching the device
+                self.health.start_plan_attempt()
+                res = self._execute_degraded(
+                    plan, inputs, schemas, {}, {}, start=0,
+                    t_plan0=time.perf_counter(), mode=self.mode)
+        if res is None:
+            if self.session is not None:
+                from ..runtime.admission import active_session
+                with active_session(self.session):
+                    res = self._execute(plan, inputs, schemas, source_fp,
+                                        cert)
+            else:
+                res = self._execute(plan, inputs, schemas, source_fp,
+                                    cert)
+        res.cert = cert
         if report is not None:
             res.optimizer = report.to_dict()
         from . import stats as stats_mod
@@ -571,9 +613,21 @@ class PlanExecutor:
                       if self.mesh is not None and self.mode == "eager"
                       else None)
         bc_rows = config.broadcast_rows() if mesh_peers else None
+        bc_bytes = config.broadcast_bytes() if mesh_peers else None
         # verify mode changes which plan survives a mid-pipeline invalid
         # rewrite (per-rule fall-back), so it belongs in the cache key too
         verify_rules = config.verify_plans()
+        # column dtypes feed the resource certifier's byte bounds (the
+        # broadcast byte-legality proof and the certified estimator
+        # tier), so the dtype signature belongs in the cache key: a
+        # rewrite proven over i8 columns must not serve an i64 binding
+        # of the same names/shapes
+        input_dtypes = {
+            name: {cn: c.dtype for cn, c in zip(t.names, t.columns)}
+            for name, t in inputs.items() if isinstance(t, Table)}
+        dtype_sig = tuple(
+            (name, tuple((cn, repr(dt)) for cn, dt in cols.items()))
+            for name, cols in sorted(input_dtypes.items()))
         # adaptive rewrites consume the stats store's observations, so
         # the store's generation joins the key: a cached rewrite must not
         # outlive the observations it ignored (each successful execution
@@ -585,8 +639,8 @@ class PlanExecutor:
                                                 store.generation)
         key = (plan.root, tuple(sorted(bound.items())),
                tuple(sorted((n, t.num_rows) for n, t in inputs.items())),
-               floats, streaming, mesh_peers, bc_rows, verify_rules,
-               stats_gen)
+               floats, streaming, mesh_peers, bc_rows, bc_bytes,
+               verify_rules, dtype_sig, stats_gen)
         hit = self._opt_cache.get(key)
         if hit is None:
             bound_rows = {n: t.num_rows for n, t in inputs.items()}
@@ -595,7 +649,7 @@ class PlanExecutor:
                 plan, bound, bound_rows,
                 float_inputs=floats, streaming_sources=streaming,
                 mesh_peers=mesh_peers, verify_rules=verify_rules,
-                stats=store, backend=backend)
+                stats=store, backend=backend, input_dtypes=input_dtypes)
             if (store is not None and not verify_rules
                     and opt is not plan and not report.fell_back
                     and report.stats_driven()):
@@ -606,10 +660,6 @@ class PlanExecutor:
                 # the same rule guards protect both paths) reverts to
                 # the static rewrite rather than failing the query.
                 from ..analysis import verifier
-                input_dtypes = {
-                    name: {cn: c.dtype
-                           for cn, c in zip(t.names, t.columns)}
-                    for name, t in inputs.items() if isinstance(t, Table)}
                 rep = verifier.verify_rewrite(
                     plan, opt, bound=bound, input_dtypes=input_dtypes,
                     float_inputs=floats, report=report,
@@ -622,16 +672,54 @@ class PlanExecutor:
                     opt, report = run_optimizer(
                         plan, bound, bound_rows,
                         float_inputs=floats, streaming_sources=streaming,
-                        mesh_peers=mesh_peers, verify_rules=verify_rules)
+                        mesh_peers=mesh_peers, verify_rules=verify_rules,
+                        input_dtypes=input_dtypes)
                     report.stats_reverted = True
             hit = (opt, opt.resolve_schemas(bound), report)
             self._opt_cache[key] = hit
         return hit
 
-    def _execute(self, plan, inputs, schemas, source_fp=None):
+    def _certify(self, plan, inputs, bound):
+        """Resource-certify the plan about to run (analysis/footprint.py):
+        bound input cardinalities (Tables and streaming sources both
+        expose num_rows), Table column dtypes for byte widths, validity
+        presence for the keyed-aggregate lo bound. Memoized per (plan,
+        binding) like the rewrite cache feeding it — a hot fingerprint-
+        cached plan must not re-pay the certify walk per execute.
+        Defensive-None on an internal certifier error — sizing is an
+        optimization layer and must never fail a query that would
+        otherwise run."""
+        from ..analysis import footprint
+        try:
+            input_dtypes, input_nullable = footprint.table_metadata(inputs)
+            bound_rows = {n: t.num_rows for n, t in inputs.items()}
+            n_peers = (self.mesh.shape[self.mesh_axis]
+                       if self.mesh is not None and self.mode == "eager"
+                       else 1)
+            key = (plan.root, tuple(sorted(bound.items())),
+                   tuple(sorted(bound_rows.items())),
+                   tuple((n, tuple((cn, repr(dt))
+                                   for cn, dt in cols.items()))
+                         for n, cols in sorted(input_dtypes.items())),
+                   tuple((n, tuple(sorted(cols.items())))
+                         for n, cols in sorted(input_nullable.items())),
+                   n_peers)
+            hit = self._cert_cache.get(key)
+            if hit is None:
+                hit = footprint.certify(
+                    plan, bound=bound, bound_rows=bound_rows,
+                    input_dtypes=input_dtypes,
+                    input_nullable=input_nullable, n_peers=n_peers)
+                self._cert_cache[key] = hit
+            return hit
+        except Exception:
+            return None
+
+    def _execute(self, plan, inputs, schemas, source_fp=None, cert=None):
         if self.mode == "eager":
             return self._execute_eager(plan, inputs, schemas)
-        return self._execute_capped(plan, inputs, schemas, source_fp)
+        return self._execute_capped(plan, inputs, schemas, source_fp,
+                                    cert)
 
     def explain(self, plan: Plan, optimized: bool = False,
                 inputs: Optional[Dict[str, Table]] = None) -> str:
@@ -654,9 +742,14 @@ class PlanExecutor:
             bound = {name: tuple(t.names) for name, t in inputs.items()}
             plan.resolve_schemas(bound)         # validate the binding
             opt, _, report = self._optimized(plan, inputs, bound)
+            # certified footprint of the EXACT plan execute() would run
+            # for this binding (analysis/footprint.py)
+            cert = self._certify(opt, inputs, bound)
+            cert_block = [cert.render()] if cert is not None else []
             return "\n".join(["== authored ==", plan.explain(), "",
                               "== optimized ==", opt.explain(), "",
-                              report.summary(), self._kernel_summary()])
+                              report.summary(), *cert_block,
+                              self._kernel_summary()])
         from .optimizer import explain_optimized
         return explain_optimized(plan) + "\n" + self._kernel_summary()
 
@@ -1402,8 +1495,58 @@ class PlanExecutor:
     def _node_cap(caps: Dict[str, int], which: str, idx: int) -> int:
         return caps.get(f"{which}:{idx}") or caps[which]
 
+    @staticmethod
+    def _cert_caps(plan, caps, cert):
+        """Fold the resource certifier's sound rows-hi bounds
+        (analysis/footprint.py) into the capped tier's capacities:
+
+        - STARTING caps tighten to the certified hi where it is below the
+          static start (a sound bound can never overflow, so a tighter
+          start only shrinks padding and compiles a smaller program —
+          per-node `row_cap:<i>`/`key_cap:<i>` entries, which outrank the
+          shared keys exactly like authored overrides);
+        - the escalation ladder CEILINGS at the certified hi (growing a
+          capacity past a proven bound is wasted memory) — per node where
+          a per-node entry exists, else on the shared key at the max hi
+          over the nodes that fall through to it (an unbounded node
+          poisons the shared ceiling, never the clamp safety).
+
+        Returns (caps, ceil) for `auto_retry_overflow(ceil=...)`; the
+        ceiling is advisory there — a clamped attempt that still
+        overflows drops it (certifier-bug escape hatch)."""
+        caps = dict(caps)
+        ceil: Dict[str, int] = {}
+        shared_hi: Dict[str, Optional[int]] = {"row_cap": 0, "key_cap": 0}
+        for i, n in enumerate(plan.nodes):
+            if isinstance(n, HashJoin) and n.how == "inner":
+                which = "row_cap"
+            elif isinstance(n, HashAggregate) and n.keys:
+                which = "key_cap"
+            else:
+                continue
+            b = cert.by_index.get(i)
+            hi = None if b is None else b.rows_hi
+            key = f"{which}:{i}"
+            if key in caps:
+                if hi is not None:
+                    if hi < caps[key]:
+                        caps[key] = hi
+                    ceil[key] = max(caps[key], hi)
+                continue
+            cur = caps.get(which)
+            if hi is not None and cur is not None and hi < cur:
+                caps[key] = hi
+                ceil[key] = hi
+            elif shared_hi[which] is not None:
+                shared_hi[which] = (None if hi is None
+                                    else max(shared_hi[which], hi))
+        for which, g in shared_hi.items():
+            if g and which in caps:
+                ceil[which] = max(g, caps[which])
+        return caps, ceil
+
     def _execute_capped(self, plan, inputs, schemas,
-                        source_fp=None) -> PlanResult:
+                        source_fp=None, cert=None) -> PlanResult:
         from ..parallel.autoretry import auto_retry_overflow
         # the capped tier traces ONE whole-plan program over concrete
         # shapes, so streaming sources materialize first — still through
@@ -1452,6 +1595,17 @@ class PlanExecutor:
                                             source_fp,
                                             executed_fp=fp).items():
                 caps[k] = max(caps.get(k, 0), v)
+        # certified cap bounds (analysis/footprint.py, docs/adaptive.md):
+        # with adaptivity on, cold starting caps tighten to the sound
+        # hi-bound and the escalation ladder ceilings at it — the warm
+        # observed high-water (merged above) must always sit at or below
+        # the certified bound; that inequality IS the certifier's
+        # soundness check (fuzz property 5). Stats off stays
+        # byte-identical static: the certifier then only stamps results.
+        from .. import config
+        cert_ceil: Dict[str, int] = {}
+        if store is not None and cert is not None and config.cert_seed():
+            caps, cert_ceil = self._cert_caps(plan, caps, cert)
         t0 = time.perf_counter()
         attempts = 0
         cache_hits = 0
@@ -1494,7 +1648,8 @@ class PlanExecutor:
         while True:
             try:
                 (table, valid, counts, overflow), final_caps = \
-                    auto_retry_overflow(run, caps, self.max_cap_attempts)
+                    auto_retry_overflow(run, caps, self.max_cap_attempts,
+                                        ceil=cert_ceil)
                 if retries:
                     self.health.record_success("plan")
                 self._caps_memo[fp] = dict(final_caps)

@@ -71,7 +71,8 @@ def render_profile(rows: List[OperatorMetrics],
                    degraded: bool = False,
                    breaker: Optional[Dict] = None,
                    optimizer: Optional[Dict] = None,
-                   jit_cache_hits: int = 0) -> str:
+                   jit_cache_hits: int = 0,
+                   cert=None) -> str:
     """Human-readable profile table (the `profile()` text form)."""
     out = []
     if plan_wall_ms is not None:
@@ -80,6 +81,17 @@ def render_profile(rows: List[OperatorMetrics],
             else ""
         out.append(f"plan: {plan_wall_ms:.3f} ms, "
                    f"{attempts} attempt(s){caps_s}{hits_s}")
+    if cert is not None:
+        # static resource certifier (analysis/footprint.py): the sound
+        # hi-bounds this execution was admitted and cap-seeded under
+        peak = ("unbounded" if cert.peak_bytes_hi is None
+                else f"{cert.peak_bytes_hi} B")
+        root_rows = ("unbounded" if cert.root.rows_hi is None
+                     else str(cert.root.rows_hi))
+        ub = (f", {len(cert.unbounded)} op(s) unbounded"
+              if cert.unbounded else "")
+        out.append(f"footprint: peak resident <= {peak} certified, "
+                   f"root rows <= {root_rows}{ub}")
     if optimizer is not None:
         fired = optimizer.get("rules_fired") or {}
         pruned = optimizer.get("pruned_columns", 0)

@@ -410,25 +410,31 @@ class _Schemas:
 
 
 # estimate-source severity lattice: a decision that consumed ANY observed
-# cardinality is stats-driven; hints outrank structural defaults
-_SRC_RANK = {"default": 0, "hint": 1, "observed": 2}
+# cardinality is stats-driven; certified bounds and hints outrank
+# structural defaults (a certified bound is SOUND but loose, a hint is
+# the author's guess at the actual — both lose to observations)
+_SRC_RANK = {"default": 0, "certified": 1, "hint": 2, "observed": 3}
 
 
 class _Estimator:
     """Row-count estimates, bottom-up. OBSERVED subtree cardinalities
     from the stats store (plan/stats.py) win for interior nodes; bound
-    table sizes win at scans; `est_rows` scan hints fill in; None
+    table sizes win at scans; `est_rows` scan hints fill in; where the
+    static chain has nothing at all, the resource certifier's sound
+    rows-hi bound (analysis/footprint.py) fills in LAST before None
     propagates (rules skip). Selectivity guesses are crude on purpose —
     only the build_side and exchange rules consume them, both behind
     margins. Alongside each estimate the SOURCE is tracked ("observed" /
-    "hint" / "default", plus the observed run count) so rule firings can
-    stamp their decision source on the report."""
+    "hint" / "certified" / "default", plus the observed run count or the
+    certified bound) so rule firings can stamp their decision source on
+    the report."""
 
     def __init__(self, bound_rows: Optional[Dict[str, int]] = None,
-                 stats=None, backend: Optional[str] = None):
+                 stats=None, backend: Optional[str] = None, cert=None):
         self.bound = dict(bound_rows or {})
         self.stats = stats          # plan/stats.StatsStore or None
         self.backend = backend
+        self.cert = cert            # node -> Optional[int] certified rows hi
         self.memo: Dict[int, Optional[float]] = {}
         self.src: Dict[int, Tuple[str, Optional[int]]] = {}
         self._subfp: Dict[int, str] = {}
@@ -447,15 +453,22 @@ class _Estimator:
         """Rendered decision source over the nodes whose estimates fed
         one rule decision: the severity-max of their sources, with the
         smallest observed run count when observed (a decision is only as
-        warm as its coldest observation)."""
-        best, runs = "default", None
+        warm as its coldest observation) and the largest certified bound
+        when certified (the loosest proof the decision leaned on)."""
+        best, runs, bnd = "default", None, None
         for n in nodes:
             s, r = self.src.get(id(n), ("default", None))
             if _SRC_RANK[s] > _SRC_RANK[best]:
                 best = s
             if s == "observed" and r is not None:
                 runs = r if runs is None else min(runs, r)
-        return f"observed:{runs}" if best == "observed" else best
+            if s == "certified" and r is not None:
+                bnd = r if bnd is None else max(bnd, r)
+        if best == "observed":
+            return f"observed:{runs}"
+        if best == "certified":
+            return f"certified:{bnd}"
+        return best
 
     def _subtree_fp(self, node: PlanNode) -> str:
         got = self._subfp.get(id(node))
@@ -471,6 +484,16 @@ class _Estimator:
         return self.stats.observed_rows(self.backend,
                                         self._subtree_fp(node))
 
+    def _certified(self, node: PlanNode) -> Optional[int]:
+        """The resource certifier's sound rows-hi bound for this node, or
+        None (no certifier wired, or the subtree is unbounded). Last
+        resort before the estimate chain gives up: a hi bound is a LOOSE
+        stand-in for a cardinality, but rules behind margins prefer it
+        over skipping the decision entirely (docs/analysis.md)."""
+        if self.cert is None:
+            return None
+        return self.cert(node)
+
     def _compute(self, node: PlanNode
                  ) -> Tuple[Optional[float], str, Optional[int]]:
         if isinstance(node, Scan):
@@ -482,12 +505,18 @@ class _Estimator:
                 return float(obs[0]), "observed", obs[1]
             if node.est_rows is not None:
                 return float(node.est_rows), "hint", None
+            c = self._certified(node)
+            if c is not None:
+                return float(c), "certified", c
             return None, "default", None
         obs = self._observed(node)
         if obs is not None:
             return float(obs[0]), "observed", obs[1]
         kids = [self.of(c) for c in node.children]
         if any(k is None for k in kids):
+            c = self._certified(node)
+            if c is not None:
+                return float(c), "certified", c
             return None, "default", None
         src, runs = "default", None
         for c in node.children:
@@ -524,14 +553,42 @@ class _Estimator:
 class _Ctx:
     def __init__(self, root, bound, bound_rows, report,
                  float_inputs=False, streaming=frozenset(),
-                 stats=None, backend=None):
+                 stats=None, backend=None, input_dtypes=None):
+        self.root = root
+        self.bound = bound
+        self.bound_rows = bound_rows
+        self.input_dtypes = input_dtypes
+        self._cert = None               # lazy footprint cert over `root`
         self.schemas = _Schemas(bound)
-        self.est = _Estimator(bound_rows, stats, backend)
+        self.est = _Estimator(bound_rows, stats, backend,
+                              cert=self.cert_rows_hi)
         self.shared = _shared_ids(root)
         self.report = report
         self.float_inputs = float_inputs
         self.streaming = streaming      # scan sources bound to streaming
         #                                 (parquet) sources this execution
+
+    def _cert_map(self):
+        """Resource-certifier bounds over this pass's root
+        (analysis/footprint.py), computed on first consult only — most
+        rule invocations never ask. Keyed by node id over the CURRENT
+        root's toposort, so estimator misses and the exchange rule's
+        byte-legality proof read the same walk."""
+        if self._cert is None:
+            from ..analysis.footprint import certify_nodes
+            self._cert = certify_nodes(
+                _toposort(self.root), bound=self.bound,
+                bound_rows=self.bound_rows,
+                input_dtypes=self.input_dtypes)
+        return self._cert
+
+    def cert_rows_hi(self, node: PlanNode) -> Optional[int]:
+        b = self._cert_map().get(id(node))
+        return None if b is None else b.rows_hi
+
+    def cert_out_bytes_hi(self, node: PlanNode) -> Optional[int]:
+        b = self._cert_map().get(id(node))
+        return None if b is None else b.out_bytes_hi
 
 
 def _rule_constant_folding(root, ctx):
@@ -1016,13 +1073,29 @@ def _plan_exchanges(root: PlanNode, ctx: "_Ctx", n_peers: int):
             l_new, r_new = kids
             le = ctx.est.of(n.left)
             re_ = ctx.est.of(n.right)
-            broadcast = (re_ is not None and re_ <= thresh
-                         and (le is None or re_ <= le))
+            row_ok = (re_ is not None and re_ <= thresh
+                      and (le is None or re_ <= le))
+            # broadcast LEGALITY is a proven byte bound
+            # (analysis/footprint.py, docs/analysis.md): the certified
+            # build-side hi must fit config.broadcast_bytes() — the row
+            # estimate stays the cost heuristic, but a mis-estimated
+            # side whose certified bytes exceed the ceiling never
+            # replicates onto every peer. Unbounded sides (strings,
+            # unbound scans) keep the row heuristic alone.
+            bytes_hi = ctx.cert_out_bytes_hi(n.right)
+            bc_bytes = config.broadcast_bytes()
+            byte_ok = bytes_hi is None or bytes_hi <= bc_bytes
+            broadcast = row_ok and byte_ok
             # decision provenance, same vocabulary as build_side: what
-            # kind of estimate picked the exchange mode for this join
+            # kind of estimate picked the exchange mode for this join —
+            # plus the byte proof (or veto) when the certifier bounded
+            # the build side
+            note = ("" if bytes_hi is None else
+                    f"; certified:{bytes_hi}B"
+                    f"{'<=' if byte_ok else '>'}{bc_bytes}B")
             report.decision_sources[f"{n.label}/exchange"] = (
                 f"{'broadcast' if broadcast else 'shuffle'} "
-                f"({ctx.est.source_of(n.left, n.right)})")
+                f"({ctx.est.source_of(n.left, n.right)}{note})")
             if broadcast:
                 r_new = add_exchange(r_new, (), "broadcast")
             else:
@@ -1133,21 +1206,23 @@ def _fall_back(plan: Plan, report: OptimizeReport):
 def _attribute_fallback(plan: Plan, bound, bound_rows, float_inputs,
                         streaming, mesh_peers,
                         err: PlanValidationError,
-                        stats=None, backend=None) -> Dict:
+                        stats=None, backend=None,
+                        input_dtypes=None) -> Dict:
     """Post-hoc attribution for the validate-or-fall-back net: re-run the
     pipeline from the authored root, re-validating after every rule that
     rewrites, to name the rule/node/invariant that produced the invalid
     DAG. Only runs on the (defensively impossible) fall-back path, so the
     duplicated rule work costs nothing in the common case. `stats`/
-    `backend` replay the SAME adaptive estimates the failing pipeline
-    consumed — attribution must reproduce the rewrite it is naming."""
+    `backend`/`input_dtypes` replay the SAME adaptive estimates and
+    certified bounds the failing pipeline consumed — attribution must
+    reproduce the rewrite it is naming."""
     scratch = OptimizeReport(rules={name: 0 for name in RULE_NAMES})
     root = plan.root
     for _ in range(MAX_PASSES):
         pass_hits = 0
         for name, rule in _RULES:
             ctx = _Ctx(root, bound, bound_rows, scratch, float_inputs,
-                       streaming, stats, backend)
+                       streaming, stats, backend, input_dtypes)
             try:
                 new_root, n = rule(root, ctx)
             except PlanValidationError as bad:
@@ -1162,7 +1237,7 @@ def _attribute_fallback(plan: Plan, bound, bound_rows, float_inputs,
             break
     if mesh_peers is not None and mesh_peers > 1:
         ctx = _Ctx(root, bound, bound_rows, scratch, float_inputs,
-                   streaming, stats, backend)
+                   streaming, stats, backend, input_dtypes)
         try:
             new_root, _ = _plan_exchanges(root, ctx, mesh_peers)
         except PlanValidationError as bad:
@@ -1184,7 +1259,9 @@ def optimize(plan: Plan,
              mesh_peers: Optional[int] = None,
              verify_rules: bool = False,
              stats=None,
-             backend: Optional[str] = None) -> Tuple[Plan, OptimizeReport]:
+             backend: Optional[str] = None,
+             input_dtypes: Optional[Dict[str, Dict]] = None
+             ) -> Tuple[Plan, OptimizeReport]:
     """Run the rule pipeline to fixpoint over `plan`. `bound` maps scan
     source -> actual column names and `bound_rows` -> actual row counts
     (execute() passes both; explain-time callers may pass neither and the
@@ -1210,7 +1287,12 @@ def optimize(plan: Plan,
     every build-side/exchange decision stamps its source on
     `report.decision_sources`. With stats=None (the
     SPARK_RAPIDS_TPU_STATS=off path) decisions are byte-identical to
-    the static pipeline.
+    the static pipeline. `input_dtypes` (source -> {column: DType})
+    enables the resource certifier's BYTE bounds
+    (analysis/footprint.py): broadcast-join legality becomes a proven
+    byte ceiling (`SPARK_RAPIDS_TPU_BROADCAST_BYTES`) and estimator
+    dead-ends fall back to certified rows-hi bounds with a
+    `certified:<bound>` decision source.
     Returns the optimized Plan (the SAME object when nothing fired) +
     the report."""
     report = OptimizeReport(rules={name: 0 for name in RULE_NAMES})
@@ -1222,7 +1304,7 @@ def optimize(plan: Plan,
             pass_hits = 0
             for name, rule in _RULES:
                 ctx = _Ctx(root, bound, bound_rows, report, float_inputs,
-                           streaming, stats, backend)
+                           streaming, stats, backend, input_dtypes)
                 new_root, n = rule(root, ctx)
                 if verify_rules and new_root is not root:
                     # post-optimize assertion, per rule: every rule's
@@ -1242,7 +1324,7 @@ def optimize(plan: Plan,
                 break
         if mesh_peers is not None and mesh_peers > 1:
             ctx = _Ctx(root, bound, bound_rows, report, float_inputs,
-                       streaming, stats, backend)
+                       streaming, stats, backend, input_dtypes)
             new_root, n = _plan_exchanges(root, ctx, mesh_peers)
             if verify_rules and new_root is not root:
                 bad = _plan_error(new_root, bound)
@@ -1259,7 +1341,7 @@ def optimize(plan: Plan,
         # culprit rather than the victim
         report.fallback = _attribute_fallback(
             plan, bound, bound_rows, float_inputs, streaming, mesh_peers,
-            err, stats, backend)
+            err, stats, backend, input_dtypes)
         return _fall_back(plan, report)
     if root is plan.root:
         report.fingerprint = report.source_fingerprint
@@ -1276,7 +1358,7 @@ def optimize(plan: Plan,
         # invariant attributed post-hoc (analysis/verifier.py vocabulary)
         report.fallback = _attribute_fallback(
             plan, bound, bound_rows, float_inputs, streaming, mesh_peers,
-            err, stats, backend)
+            err, stats, backend, input_dtypes)
         return _fall_back(plan, report)
     report.fingerprint = opt.fingerprint
     return opt, report

@@ -351,6 +351,14 @@ class StatsStore:
                 lines = f.readlines()
         except OSError:
             return
+        # construction is single-threaded today, but the tables this
+        # fills are the lock-protected shared state — the lint_hazards
+        # lock-discipline rule (tools/lint_hazards.py) holds every
+        # mutation site to the same standard, replay included
+        with self._lock:
+            self._load_locked(lines)
+
+    def _load_locked(self, lines) -> None:
         for line in lines:
             try:
                 ev = json.loads(line)
@@ -396,6 +404,11 @@ class StatsStore:
 # ---- process wiring ---------------------------------------------------------
 
 _default_store: Optional[StatsStore] = None
+# guards the singleton hand-off: without it two threads racing first use
+# would construct two stores and BOTH replay the persistence file —
+# double-counted EWMAs and a torn generation counter (the
+# unguarded-module-global-mutation lint rule now machine-checks this)
+_default_lock = threading.Lock()
 # explicit-scope stack: tests/benches push a store (or None, to force
 # adaptivity OFF regardless of the knob) — the top outranks the knob.
 # THREAD-LOCAL, like runtime/admission's active_session: concurrent
@@ -416,14 +429,16 @@ def default_store() -> StatsStore:
     """The process singleton (capacity/path snapshot from config at first
     construction; `reset_default_store` re-reads)."""
     global _default_store
-    if _default_store is None:
-        _default_store = StatsStore()
-    return _default_store
+    with _default_lock:
+        if _default_store is None:
+            _default_store = StatsStore()
+        return _default_store
 
 
 def reset_default_store() -> None:
     global _default_store
-    _default_store = None
+    with _default_lock:
+        _default_store = None
 
 
 def active_store() -> Optional[StatsStore]:

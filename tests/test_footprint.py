@@ -1,0 +1,447 @@
+"""Static resource certifier (spark_rapids_tpu/analysis/footprint.py,
+docs/analysis.md): one hand-built plan per bound class — filter, join
+build side, two-phase aggregate, exchange payload, streaming morsel —
+plus the three consumers: admission reject/degrade, the optimizer's
+broadcast byte-legality proof and certified estimator tier, and the
+capped tier's cold cap seeding/ceiling."""
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from spark_rapids_tpu import dtypes
+from spark_rapids_tpu.columnar import Column, Table
+from spark_rapids_tpu.analysis import (ResourceAdmissionError, certify,
+                                       certify_nodes)
+from spark_rapids_tpu.plan import (PlanBuilder, PlanExecutor, col, lit)
+from spark_rapids_tpu.plan import stats as stats_mod
+from spark_rapids_tpu.plan.builder import Plan, _toposort
+from spark_rapids_tpu.plan.nodes import Exchange, Scan
+
+
+def _tbl(**cols) -> Table:
+    out, names = [], []
+    for n, v in cols.items():
+        a = np.asarray(v)
+        dt = dtypes.FLOAT64 if a.dtype.kind == "f" else (
+            dtypes.BOOL if a.dtype.kind == "b" else dtypes.INT64)
+        out.append(Column(dtype=dt, length=len(a),
+                          data=jnp.asarray(a.astype(dt.storage_dtype()))))
+        names.append(n)
+    return Table(out, names=names)
+
+
+def _cert_kw(inputs):
+    return dict(
+        bound={n: tuple(t.names) for n, t in inputs.items()},
+        bound_rows={n: t.num_rows for n, t in inputs.items()},
+        input_dtypes={n: {cn: c.dtype
+                          for cn, c in zip(t.names, t.columns)}
+                      for n, t in inputs.items()},
+        input_nullable={n: {cn: c.validity is not None
+                            for cn, c in zip(t.names, t.columns)}
+                        for n, t in inputs.items()})
+
+
+def _sound(res, inputs):
+    cert = certify(res.plan, **_cert_kw(inputs))
+    for lbl, m in res.metrics.items():
+        b = cert.by_label[lbl]
+        assert b.rows_lo <= m.rows_out, (lbl, m.rows_out, b)
+        if b.rows_hi is not None:
+            assert m.rows_out <= b.rows_hi, (lbl, m.rows_out, b)
+        if res.mode == "eager" and b.out_bytes_hi is not None:
+            assert m.bytes_out <= b.out_bytes_hi, (lbl, m.bytes_out, b)
+    return cert
+
+
+# ---------------------------------------------------------------------------
+# bound classes
+# ---------------------------------------------------------------------------
+
+def test_filter_bound_collapses_lo_never_hi():
+    b = PlanBuilder()
+    plan = b.scan("t", schema=["k", "v"]).filter(col("v") > 100).build()
+    inputs = {"t": _tbl(k=[1, 2, 3], v=[1, 2, 3])}
+    cert = certify(plan, **_cert_kw(inputs))
+    f = next(bb for bb in cert.ops if bb.kind == "Filter")
+    assert (f.rows_lo, f.rows_hi) == (0, 3)
+    # int64 data + assumed validity plane: 9 B/column/row, 2 columns
+    assert f.row_bytes == 18 and f.out_bytes_hi == 54
+    res = PlanExecutor(mode="eager").execute(plan, inputs)
+    _sound(res, inputs)          # everything filtered: 0 in [0, 3]
+
+
+def test_join_build_side_working_set():
+    b = PlanBuilder()
+    l = b.scan("l", schema=["k", "v"])
+    r = b.scan("r", schema=["k2", "w"])
+    plan = l.join(r, "k", "k2").build()
+    inputs = {"l": _tbl(k=[1, 1, 2], v=[1, 2, 3]),
+              "r": _tbl(k2=[1, 1], w=[5, 6])}
+    cert = certify(plan, **_cert_kw(inputs))
+    j = next(bb for bb in cert.ops if bb.kind == "HashJoin")
+    assert j.rows_hi == 3 * 2            # cross-product bound
+    # build (right) table resident while probing: 2 rows x 2 cols x 9 B
+    assert j.working_bytes_hi == 36
+    assert j.resident_bytes_hi == (j.out_bytes_hi + 36
+                                   + 3 * 18 + 2 * 18)
+    res = PlanExecutor(mode="eager").execute(plan, inputs)
+    _sound(res, inputs)                  # 4 matches <= 6
+
+
+def test_two_phase_aggregate_hash_table_bound():
+    b = PlanBuilder()
+    plan = b.scan("t", schema=["k", "v"]).aggregate(
+        ["k"], [("v", "sum", "s"), ("v", "count", "c")]).build()
+    inputs = {"t": _tbl(k=[1, 1, 2, 2], v=[1, 2, 3, 4])}
+    cert = certify(plan, **_cert_kw(inputs))
+    a = next(bb for bb in cert.ops if bb.kind == "HashAggregate")
+    # distinct groups <= input rows; non-null int keys + rows>0 => lo=1
+    assert (a.rows_lo, a.rows_hi) == (1, 4)
+    # accumulators certify at 64-bit: key 9 B + 2 aggs x 9 B per slot
+    assert a.row_bytes == 9 + 2 * 9
+    assert a.working_bytes_hi == 4 * a.row_bytes
+    res = PlanExecutor(mode="capped").execute(plan, inputs)
+    _sound(res, inputs)                  # 2 groups in [1, 4]
+
+
+def test_keyed_aggregate_lo_collapses_under_nullable_keys():
+    b = PlanBuilder()
+    plan = b.scan("t", schema=["k", "v"]).aggregate(
+        ["k"], [("v", "sum", "s")]).build()
+    inputs = {"t": _tbl(k=[1], v=[2])}
+    kw = _cert_kw(inputs)
+    kw["input_nullable"] = {"t": {"k": True, "v": False}}
+    cert = certify(plan, **kw)
+    a = next(bb for bb in cert.ops if bb.kind == "HashAggregate")
+    assert a.rows_lo == 0     # a null-keyed row's grouping is kernel
+    #                           policy the certifier must not assume
+
+
+def test_exchange_payload_bounds_per_kind():
+    scan = Scan("t", schema=("k", "v"))
+    hash_ex = Exchange(scan, ("k",), how="hash")
+    plan = Plan(hash_ex)
+    inputs = {"t": _tbl(k=[1, 2, 3, 4], v=[1, 2, 3, 4])}
+    by_id = certify_nodes(_toposort(hash_ex), n_peers=4, **_cert_kw(inputs))
+    ex = by_id[id(hash_ex)]
+    assert ex.exchange_bytes_hi == 4 * 18     # each row moves at most once
+    bcast = Exchange(scan, (), how="broadcast")
+    by_id = certify_nodes(_toposort(bcast), n_peers=4, **_cert_kw(inputs))
+    assert by_id[id(bcast)].exchange_bytes_hi == 4 * 18 * 3   # n-1 copies
+    gather = Exchange(scan, (), how="gather")
+    by_id = certify_nodes(_toposort(gather), n_peers=4, **_cert_kw(inputs))
+    assert by_id[id(gather)].exchange_bytes_hi == 4 * 18
+    # single chip: exchanges move nothing
+    by_id = certify_nodes(_toposort(hash_ex), n_peers=1, **_cert_kw(inputs))
+    assert by_id[id(hash_ex)].exchange_bytes_hi == 0
+    assert certify(plan, n_peers=4,
+                   **_cert_kw(inputs)).exchange_bytes_hi == 4 * 18
+
+
+def test_streaming_morsel_chain_bounds(tmp_path):
+    pq = pytest.importorskip("pyarrow.parquet")
+    import pyarrow as pa
+    path = str(tmp_path / "t.parquet")
+    n = 512
+    pq.write_table(pa.table({"k": np.arange(n, dtype=np.int64),
+                             "v": np.arange(n, dtype=np.int64)}),
+                   path, row_group_size=64)
+    b = PlanBuilder()
+    plan = b.scan("t", parquet=path).filter(col("k") < 100).build()
+    res = PlanExecutor(mode="eager").execute(plan, {})
+    # the scan's bound comes from the parquet FOOTER (no bound table);
+    # row-group pruning may drop groups, so lo collapses to 0 on a
+    # pruning scan while hi stays the footer count
+    inputs = {"t": next(s for s in res.plan.nodes
+                        if isinstance(s, Scan)).parquet}
+    cert = certify(res.plan,
+                   bound={"t": ("k", "v")},
+                   bound_rows={"t": inputs["t"].num_rows})
+    s = next(bb for bb in cert.ops if bb.kind == "Scan")
+    assert s.rows_hi == n and s.rows_lo == 0
+    for lbl, m in res.metrics.items():
+        bb = cert.by_label[lbl]
+        assert bb.rows_lo <= m.rows_out <= bb.rows_hi, (lbl, m, bb)
+    scan_m = next(m for m in res.metrics.values() if m.kind == "Scan")
+    assert scan_m.rows_out < n           # pruning actually pruned
+
+
+def test_unbounded_inputs_poison_bytes_never_rows():
+    b = PlanBuilder()
+    plan = b.scan("t", schema=["k", "s"]).filter(col("k") > 0).build()
+    from spark_rapids_tpu.columnar.column import make_string_column
+    strings = make_string_column(
+        jnp.asarray(np.frombuffer(b"abdef", dtype=np.uint8)),
+        jnp.asarray(np.asarray([0, 2, 5], dtype=np.int32)))
+    k = Column(dtype=dtypes.INT64, length=2,
+               data=jnp.asarray(np.asarray([1, 2], dtype=np.int64)))
+    inputs = {"t": Table([k, strings], names=["k", "s"])}
+    cert = certify(plan, **_cert_kw(inputs))
+    f = next(bb for bb in cert.ops if bb.kind == "Filter")
+    assert f.rows_hi == 2                # rows still bound
+    assert f.out_bytes_hi is None        # string column: bytes unbounded
+    assert f.label in cert.unbounded
+    assert cert.over_budget(1) == []     # unbounded is reported, not
+    #                                      rejected (sound-but-incomplete)
+
+
+# ---------------------------------------------------------------------------
+# consumer 1: admission
+# ---------------------------------------------------------------------------
+
+def _join_plan_and_inputs():
+    b = PlanBuilder()
+    l = b.scan("l", schema=["k", "v"])
+    r = b.scan("r", schema=["k2", "w"])
+    plan = l.join(r, "k", "k2").aggregate(["k"], [("w", "sum", "s")]).build()
+    inputs = {"l": _tbl(k=[1, 2, 3, 1], v=[1, 3, 4, 5]),
+              "r": _tbl(k2=[1, 2, 2], w=[10, 20, 30])}
+    return plan, inputs
+
+
+def test_admission_rejects_over_budget_before_compilation():
+    plan, inputs = _join_plan_and_inputs()
+    ex = PlanExecutor(mode="capped", cert_budget=100)
+    with pytest.raises(ResourceAdmissionError) as ei:
+        ex.execute(plan, inputs)
+    v = ei.value.violations[0]
+    assert v.invariant == "footprint.over-budget"
+    assert "#" in v.node                 # operator-labelled diagnostic
+    assert str(v.node.split("#")[0]) in ("Scan", "HashJoin",
+                                         "HashAggregate", "Project",
+                                         "FusedSelect")
+    assert len(ex._jit_cache) == 0       # rejected BEFORE any compilation
+
+
+def test_admission_budget_env_knob_and_pass(monkeypatch):
+    plan, inputs = _join_plan_and_inputs()
+    ref = PlanExecutor(mode="capped").execute(plan, dict(inputs))
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_CERT_BUDGET_BYTES", "100")
+    with pytest.raises(ResourceAdmissionError):
+        PlanExecutor(mode="capped").execute(plan, dict(inputs))
+    # a roomy budget admits; the cert is stamped on the result
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_CERT_BUDGET_BYTES", str(1 << 30))
+    res = PlanExecutor(mode="capped").execute(plan, dict(inputs))
+    assert res.cert is not None and res.cert.peak_bytes_hi <= (1 << 30)
+    assert res.compact().to_pydict() == ref.compact().to_pydict()
+    # ctor budget outranks the knob
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_CERT_BUDGET_BYTES", "100")
+    res = PlanExecutor(mode="capped", cert_budget=1 << 30).execute(
+        plan, dict(inputs))
+    assert res.cert is not None
+
+
+def test_admission_degrade_policy_runs_cpu_tier(monkeypatch):
+    plan, inputs = _join_plan_and_inputs()
+    ref = PlanExecutor(mode="eager").execute(plan, dict(inputs))
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_CERT_ADMISSION", "degrade")
+    res = PlanExecutor(mode="eager", cert_budget=100).execute(
+        plan, dict(inputs))
+    assert res.degraded
+    assert res.compact().to_pydict() == ref.compact().to_pydict()
+    assert all(m.degraded for m in res.metrics.values())
+
+
+def test_admission_typo_policy(monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_CERT_ADMISSION", "degarde")
+    plan, inputs = _join_plan_and_inputs()
+    with pytest.raises(ValueError):
+        PlanExecutor(mode="eager", cert_budget=100).execute(plan, inputs)
+
+
+def test_cert_stamped_on_result_and_profile():
+    plan, inputs = _join_plan_and_inputs()
+    res = PlanExecutor(mode="eager").execute(plan, dict(inputs))
+    assert res.cert is not None
+    assert res.cert.root.rows_hi == 12   # 4 x 3 cross-product bound
+    assert "footprint: peak resident <=" in res.profile_text()
+    d = res.cert.to_dict()
+    assert d["root_rows_hi"] == 12 and d["ops"]
+    # explain(optimized=True, inputs=...) renders the cert block
+    txt = PlanExecutor(mode="eager").explain(plan, optimized=True,
+                                             inputs=dict(inputs))
+    assert "resource cert" in txt
+
+
+# ---------------------------------------------------------------------------
+# consumer 2: optimizer (broadcast byte proof, certified estimates)
+# ---------------------------------------------------------------------------
+
+def test_broadcast_byte_legality_vetoes_row_heuristic(monkeypatch):
+    from spark_rapids_tpu.plan.optimizer import optimize
+    b = PlanBuilder()
+    l = b.scan("l", schema=["k", "v"])
+    r = b.scan("r", schema=["k2", "w"])
+    plan = l.join(r, "k", "k2").aggregate(["k"],
+                                          [("w", "sum", "s")]).build()
+    bound = {"l": ("k", "v"), "r": ("k2", "w")}
+    bound_rows = {"l": 4096, "r": 64}     # 64 rows: row heuristic says
+    dts = {"l": {"k": dtypes.INT64, "v": dtypes.INT64},
+           "r": {"k2": dtypes.INT64, "w": dtypes.INT64}}
+    # roomy byte ceiling: broadcast, with the byte proof on the stamp
+    opt, report = optimize(plan, bound, bound_rows, mesh_peers=4,
+                           input_dtypes=dts)
+    (src,) = [v for k, v in report.decision_sources.items()
+              if k.endswith("/exchange")]
+    assert src.startswith("broadcast") and "certified:" in src
+    # 1-byte ceiling: the proof fails, the SAME row estimates now shuffle
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_BROADCAST_BYTES", "1")
+    opt, report = optimize(plan, bound, bound_rows, mesh_peers=4,
+                           input_dtypes=dts)
+    (src,) = [v for k, v in report.decision_sources.items()
+              if k.endswith("/exchange")]
+    assert src.startswith("shuffle") and ">" in src
+    assert report.exchanges.get("broadcast", 0) == 0
+    # no dtypes -> no byte proof -> row heuristic alone (unbounded side)
+    opt, report = optimize(plan, bound, bound_rows, mesh_peers=4)
+    (src,) = [v for k, v in report.decision_sources.items()
+              if k.endswith("/exchange")]
+    assert src.startswith("broadcast") and "certified:" not in src
+
+
+def test_estimator_certified_tier_fills_static_dead_end(tmp_path):
+    pq = pytest.importorskip("pyarrow.parquet")
+    import pyarrow as pa
+    from spark_rapids_tpu.plan.optimizer import optimize
+    path = str(tmp_path / "small.parquet")
+    pq.write_table(pa.table({"k2": np.arange(8, dtype=np.int64),
+                             "w": np.arange(8, dtype=np.int64)}), path)
+    from spark_rapids_tpu.io.parquet import ParquetSource
+    # authored directly: NO est_rows hint, NO binding at optimize time —
+    # the static estimate chain dead-ends, the certifier's footer-count
+    # bound fills in with `certified:<bound>` provenance
+    r = Scan("r", schema=("k2", "w"), parquet=ParquetSource(path))
+    b = PlanBuilder()
+    plan = Plan(__import__(
+        "spark_rapids_tpu.plan.nodes", fromlist=["HashAggregate"]
+    ).HashAggregate(
+        __import__("spark_rapids_tpu.plan.nodes",
+                   fromlist=["HashJoin"]).HashJoin(
+            b.scan("l", schema=["k", "v"]).node, r, ("k",), ("k2",)),
+        ("k",), (("w", "sum", "s"),)))
+    opt, report = optimize(plan, None, {"l": 64}, mesh_peers=4)
+    (src,) = [v for k, v in report.decision_sources.items()
+              if k.endswith("/exchange")]
+    assert "certified:8" in src
+
+
+# ---------------------------------------------------------------------------
+# consumer 3: capped-tier cap seeding / escalation ceiling
+# ---------------------------------------------------------------------------
+
+def test_cold_cap_seeding_tightens_below_static_default():
+    # a Limit bounds the join inputs far below the table sizes: the
+    # certified join hi (3 x 3 = 9) sits well under the static default
+    # cap (max input rows = 64), so the cold adaptive run starts tighter
+    b = PlanBuilder()
+    big = list(range(64))
+    l = b.scan("l", schema=["k", "v"]).limit(3)
+    r = b.scan("r", schema=["k2", "w"]).limit(3)
+    plan = l.join(r, "k", "k2").aggregate(["k"],
+                                          [("w", "sum", "s")]).build()
+    inputs = {"l": _tbl(k=big, v=big), "r": _tbl(k2=big, w=big)}
+    with stats_mod.scoped_store(None):
+        static = PlanExecutor(mode="capped").execute(plan, dict(inputs))
+    assert static.caps["row_cap"] == 64 and ":" not in str(
+        sorted(static.caps))
+    with stats_mod.scoped_store(stats_mod.StatsStore(capacity=8,
+                                                     path="")):
+        cold = PlanExecutor(mode="capped").execute(plan, dict(inputs))
+    tightened = {k: v for k, v in cold.caps.items() if ":" in k}
+    assert tightened and all(v == 9 for k, v in tightened.items()
+                             if k.startswith("row_cap")), cold.caps
+    assert cold.attempts == 1            # a sound bound cannot overflow
+    assert cold.compact().to_pydict() == static.compact().to_pydict()
+
+
+def test_cert_seed_off_restores_static_caps(monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_CERT_SEED", "off")
+    b = PlanBuilder()
+    big = list(range(64))
+    plan = b.scan("l", schema=["k", "v"]).limit(3).join(
+        b.scan("r", schema=["k2", "w"]).limit(3), "k", "k2").aggregate(
+        ["k"], [("w", "sum", "s")]).build()
+    inputs = {"l": _tbl(k=big, v=big), "r": _tbl(k2=big, w=big)}
+    with stats_mod.scoped_store(stats_mod.StatsStore(capacity=8,
+                                                     path="")):
+        cold = PlanExecutor(mode="capped").execute(plan, dict(inputs))
+    assert not any(":" in k for k in cold.caps)
+
+
+def test_escalation_ceiling_clamps_at_certified_hi():
+    # fan-out join overflows the default start; the ladder must stop AT
+    # the certified hi instead of the next power-of-two rung above it
+    b = PlanBuilder()
+    l = b.scan("l", schema=["k"])
+    r = b.scan("r", schema=["k2"])
+    plan = l.join(r, "k", "k2").aggregate(["k"],
+                                          [("k2", "size", "n")]).build()
+    # 9 x 7 = 63 matches: the geometric ladder from the default start
+    # (max input rows = 9) lands on 72, OVER the certified hi of 63
+    inputs = {"l": _tbl(k=[1] * 9), "r": _tbl(k2=[1] * 7)}
+    with stats_mod.scoped_store(None):
+        static = PlanExecutor(mode="capped").execute(plan, dict(inputs))
+    with stats_mod.scoped_store(stats_mod.StatsStore(capacity=8,
+                                                     path="")):
+        cold = PlanExecutor(mode="capped").execute(plan, dict(inputs))
+        warm = PlanExecutor(mode="capped").execute(plan, dict(inputs))
+    # same number of attempts (the clamp never changes which attempt
+    # succeeds), but the final capacity is the proof, not the rung
+    assert cold.attempts == static.attempts > 1
+    assert max(v for k, v in cold.caps.items()
+               if k.startswith("row_cap")) == 63          # 9 x 7 = hi
+    assert max(v for k, v in static.caps.items()
+               if k.startswith("row_cap")) == 72          # geometric rung
+    assert warm.attempts == 1            # observed high-water, PR 11
+    # the soundness inequality: observed high-water <= certified bound
+    assert all(v <= 63 for k, v in warm.caps.items()
+               if k.startswith("row_cap"))
+    assert (cold.compact().to_pydict() == warm.compact().to_pydict()
+            == static.compact().to_pydict())
+
+
+def test_autoretry_ceiling_escape_hatch():
+    # a WRONG ceiling (below the true requirement) must not turn a
+    # recoverable overflow into CapacityOverflowError: one clamped
+    # attempt overflows, the ceiling is dropped, geometric growth resumes
+    from spark_rapids_tpu.parallel.autoretry import auto_retry_overflow
+    calls = []
+
+    def attempt(row_cap):
+        calls.append(row_cap)
+        return ("t", jnp.asarray(row_cap < 40))
+
+    out, caps = auto_retry_overflow(attempt, {"row_cap": 4},
+                                    max_attempts=8,
+                                    ceil={"row_cap": 10})
+    assert caps["row_cap"] >= 40 and out[0] == "t"
+    assert 10 in calls                   # the clamped attempt ran once
+
+
+# ---------------------------------------------------------------------------
+# soundness on executed NDS-shaped plans (fuzz covers random DAGs)
+# ---------------------------------------------------------------------------
+
+def test_soundness_eager_and_capped_on_join_agg_plan():
+    plan, inputs = _join_plan_and_inputs()
+    for mode in ("eager", "capped"):
+        res = PlanExecutor(mode=mode).execute(plan, dict(inputs))
+        _sound(res, inputs)
+
+
+def test_monotonicity_root_bound_never_loosens():
+    from spark_rapids_tpu.plan.optimizer import optimize
+    b = PlanBuilder()
+    plan = b.scan("t", schema=["k", "v", "dead"]).filter(
+        col("v") > lit(1)).project(
+        {"k": col("k"), "v": col("v")}).sort(["k"]).limit(5).build()
+    inputs = {"t": _tbl(k=[3, 1, 2], v=[5, 0, 7], dead=[1, 1, 1])}
+    kw = _cert_kw(inputs)
+    opt, report = optimize(plan, kw["bound"], kw["bound_rows"])
+    a = certify(plan, **kw).root
+    o = certify(opt, **kw).root
+    assert o.rows_hi <= a.rows_hi
+    assert o.out_bytes_hi <= a.out_bytes_hi

@@ -534,9 +534,77 @@ class TestHazardLinter:
         with pytest.raises(SystemExit):
             lint.load_allowlist(str(bad))
 
+    def test_lock_discipline_inconsistent_guard(self, tmp_path):
+        """Mutating an attribute the class locks elsewhere, without the
+        lock: the PR 11 thread-safety classes (StatsStore,
+        KernelRegistry), machine-checked."""
+        lint = _load_linter()
+        f = tmp_path / "lockmod.py"
+        f.write_text(
+            "import threading\n"
+            "class Store:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "        self._plans = {}\n"
+            "        self.hits = 0\n"
+            "    def record(self, k, v):\n"
+            "        with self._lock:\n"
+            "            self._plans[k] = v\n"
+            "            self.hits += 1\n"
+            "    def load(self, items):\n"
+            "        for k, v in items:\n"
+            "            self._plans[k] = v\n"      # BAD: no lock
+            "    def _fill_locked(self, k):\n"
+            "        self._plans[k] = 1\n"          # fine: convention
+            "    def unrelated(self):\n"
+            "        self.note = 1\n")              # never locked: fine
+        findings = lint.lint_paths([str(f)], str(tmp_path))
+        hits = [x for x in findings if x.rule == "lock-discipline"]
+        assert len(hits) == 1 and hits[0].context == "Store.load", findings
+
+    def test_global_mutation_rule(self, tmp_path):
+        lint = _load_linter()
+        f = tmp_path / "globmod.py"
+        f.write_text(
+            "import threading\n"
+            "_g_lock = threading.Lock()\n"
+            "_A = None\n"
+            "_B = None\n"
+            "def bad():\n"
+            "    global _A\n"
+            "    if _A is None:\n"
+            "        _A = object()\n"               # BAD: unguarded
+            "    return _A\n"
+            "def good():\n"
+            "    global _B\n"
+            "    with _g_lock:\n"
+            "        if _B is None:\n"
+            "            _B = object()\n"           # fine: under the lock
+            "    return _B\n")
+        findings = lint.lint_paths([str(f)], str(tmp_path))
+        hits = [x for x in findings if x.rule == "global-mutation"]
+        assert len(hits) == 1 and hits[0].context == "bad", findings
+
+    def test_stale_allowlist_entry_fails_the_run(self, tmp_path,
+                                                 capsys):
+        """A stale entry is a premerge FAILURE (exit 1), not a note."""
+        lint = _load_linter()
+        src = tmp_path / "clean.py"
+        src.write_text("x = 1\n")
+        allow = tmp_path / "allow.txt"
+        allow.write_text(
+            "gone.py::tracer-branch::old_fn  # vetted long ago\n")
+        rc = lint.main([str(src), "--allowlist", str(allow)])
+        assert rc == 1
+        assert "STALE" in capsys.readouterr().out
+        # an empty allowlist over a clean file: exit 0
+        allow.write_text("")
+        assert lint.main([str(src), "--allowlist", str(allow)]) == 0
+
     def test_repo_is_clean_under_allowlist(self):
         """The premerge contract, asserted in-tree: the linter over
-        spark_rapids_tpu/ has no unsuppressed findings."""
+        spark_rapids_tpu/ has no unsuppressed findings AND no stale
+        allowlist entries."""
         lint = _load_linter()
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         allow = lint.load_allowlist(
@@ -545,3 +613,53 @@ class TestHazardLinter:
             [os.path.join(root, "spark_rapids_tpu")], root)
         open_findings = [f for f in findings if f.key() not in allow]
         assert not open_findings, "\n".join(map(str, open_findings))
+        stale = set(allow) - {f.key() for f in findings}
+        assert not stale, f"stale allowlist entries: {sorted(stale)}"
+
+
+# ---------------------------------------------------------------------------
+# bench-JSONL stamp linter (tools/lint_metrics.py)
+# ---------------------------------------------------------------------------
+
+def _load_metrics_linter():
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "lint_metrics", os.path.join(root, "tools", "lint_metrics.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["lint_metrics"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestMetricsLinter:
+    def test_missing_kernels_stamp(self, tmp_path):
+        lint = _load_metrics_linter()
+        f = tmp_path / "bmod.py"
+        f.write_text(
+            "from benchmarks.common import emit_record, run_config\n"
+            "emit_record('b', {}, 1.0, 10)\n"
+            "run_config('b', {}, None, (), n_rows=1, kernels='fallback')\n")
+        findings = []
+        lint._lint_file(str(f), "benchmarks/bmod.py", findings)
+        assert len(findings) == 1 and "missing-kernels-stamp" in findings[0]
+        assert ":2:" in findings[0]
+
+    def test_raw_jsonl_stamp_and_error_exemption(self, tmp_path):
+        lint = _load_metrics_linter()
+        f = tmp_path / "raw.py"
+        f.write_text(
+            "import json\n"
+            "print(json.dumps({'bench': 'x', 'ms': 1}))\n"
+            "print(json.dumps({'bench': 'x', 'error': 'boom'}))\n"
+            "print(json.dumps({'bench': 'x', 'backend': 'cpu',\n"
+            "                  'kernels': 'fallback'}))\n")
+        findings = []
+        lint._lint_file(str(f), "benchmarks/raw.py", findings)
+        assert len(findings) == 1 and "raw-jsonl-missing-stamp" in \
+            findings[0]
+
+    def test_tree_is_clean(self):
+        """The premerge contract: benchmarks/ + bench.py fully stamped."""
+        lint = _load_metrics_linter()
+        assert lint.main([]) == 0

@@ -24,6 +24,20 @@ this makes the catch permanent and premerge-enforced (ci/premerge.sh):
   or `set()`/`frozenset()` iteration inside fingerprint-computing
   functions — nondeterministic order feeding a structural hash silently
   splits the compiled-program cache (or worse, collides).
+- ``lock-discipline``: inconsistent lock guards in a lock-owning class
+  (one that assigns ``threading.Lock()``/``RLock()`` to an attribute).
+  Any attribute the class mutates under its lock somewhere is SHARED
+  STATE; mutating it anywhere else without the lock is a race waiting
+  for a second thread (the PR 11 thread-safety classes — `StatsStore`,
+  `KernelRegistry` — are now machine-checked). ``__init__``/
+  ``__post_init__`` and ``*_locked`` helper methods (the
+  called-under-lock convention) are exempt call sites.
+- ``global-mutation``: a module global reassigned (``global X; X = ...``)
+  outside any lock-shaped ``with`` block. Racing first-use
+  initializers construct twice — for stateful singletons (the stats
+  store's persistence replay, faultinj's saved-original tables) that is
+  double-counted state, not just wasted work. Idempotent pure-value
+  caches belong in the allowlist with that justification.
 
 Vetted exceptions live in the allowlist (default
 ``tools/lint_hazards_allowlist.txt``), one per line::
@@ -31,11 +45,15 @@ Vetted exceptions live in the allowlist (default
     <repo/relative/path.py>::<rule>::<qualified.context>  # justification
 
 The justification is REQUIRED — an allowlist entry without a reason
-fails the run. Usage::
+fails the run. A STALE entry (one matching no current finding) is a
+FAILURE too, not a note: an entry that outlives its finding is a
+standing suppression of whatever regresses into that slot next — prune
+it in the same change that fixed the code. Usage::
 
     python tools/lint_hazards.py [paths...] [--allowlist FILE] [--list]
 
-Exit status 1 when any unsuppressed finding remains.
+Exit status 1 when any unsuppressed finding remains, or any allowlist
+entry has gone stale.
 """
 from __future__ import annotations
 
@@ -197,6 +215,8 @@ class _ModuleLinter:
     def run(self) -> List[Finding]:
         self._scan_scope(self.tree.body, [])
         self._scan_env(self.tree)
+        self._scan_locking(self.tree.body, [])
+        self._scan_globals(self.tree.body, [])
         return self.findings
 
     def _add(self, rule: str, node, qual: List[str], msg: str):
@@ -356,6 +376,110 @@ class _ModuleLinter:
         for fn, fq in fingerprints:
             self._lint_fingerprint(fn, fq)
 
+    # ---- lock discipline (shared-state classes) ----------------------------
+    def _scan_locking(self, body, qual: List[str]):
+        """Find lock-owning classes at any nesting depth and hold their
+        shared-state mutations to the lock (see _LockLinter)."""
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_locking(stmt.body, qual + [stmt.name])
+            elif isinstance(stmt, ast.ClassDef):
+                locks: Set[str] = set()
+                for node in stmt.body:
+                    # class-level lock attribute (`_lock = Lock()`)
+                    if isinstance(node, ast.Assign) and \
+                            _is_lock_ctor(node.value):
+                        locks.update(t.id for t in node.targets
+                                     if isinstance(t, ast.Name))
+                for node in ast.walk(stmt):
+                    # instance lock (`self._lock = Lock()` in any method)
+                    if isinstance(node, ast.Assign) and \
+                            _is_lock_ctor(node.value):
+                        locks.update(
+                            _self_attr_of(t) for t in node.targets
+                            if _self_attr_of(t))
+                locks.discard("")
+                if locks:
+                    _LockLinter(self, stmt, qual + [stmt.name],
+                                locks).run()
+                self._scan_locking(stmt.body, qual + [stmt.name])
+
+    # ---- module-global mutation --------------------------------------------
+    def _module_locks(self) -> Set[str]:
+        """Module-level names bound to threading.Lock()/RLock() — a
+        `with <that name>:` counts as a lock regardless of its name."""
+        got = getattr(self, "_module_lock_names", None)
+        if got is None:
+            got = {t.id for stmt in self.tree.body
+                   if isinstance(stmt, ast.Assign)
+                   and _is_lock_ctor(stmt.value)
+                   for t in stmt.targets if isinstance(t, ast.Name)}
+            self._module_lock_names = got
+        return got
+
+    def _scan_globals(self, body, qual: List[str]):
+        for stmt in body:
+            if isinstance(stmt, ast.ClassDef):
+                self._scan_globals(stmt.body, qual + [stmt.name])
+                continue
+            if not isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            fq = qual + [stmt.name]
+            self._scan_globals(stmt.body, fq)
+            declared: Set[str] = set()
+            for node in _scope_walk(stmt):
+                if isinstance(node, ast.Global):
+                    declared.update(node.names)
+            if not declared:
+                continue
+            # *_locked functions are called under the lock by convention
+            # — same contract as the lock-discipline rule's method exempt
+            self._walk_global_writes(stmt.body, fq, declared,
+                                     stmt.name.endswith("_locked"))
+
+    def _walk_global_writes(self, body, qual, names: Set[str],
+                            under: bool):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.With):
+                locks = self._module_locks()
+                inner = under or any(
+                    _lockish(item.context_expr)
+                    or _dotted(item.context_expr) in locks
+                    for item in stmt.items)
+                self._walk_global_writes(stmt.body, qual, names, inner)
+                continue
+            if isinstance(stmt, (ast.If, ast.While, ast.For)):
+                self._walk_global_writes(stmt.body, qual, names, under)
+                self._walk_global_writes(stmt.orelse, qual, names, under)
+                continue
+            if isinstance(stmt, ast.Try):
+                for b in (stmt.body, stmt.orelse, stmt.finalbody):
+                    self._walk_global_writes(b, qual, names, under)
+                for h in stmt.handlers:
+                    self._walk_global_writes(h.body, qual, names, under)
+                continue
+            if isinstance(stmt, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)) and not under:
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for t in targets:
+                    for el in (t.elts if isinstance(
+                            t, (ast.Tuple, ast.List)) else [t]):
+                        if isinstance(el, ast.Name) and el.id in names:
+                            self._add(
+                                "global-mutation", stmt, qual,
+                                f"module global `{el.id}` reassigned "
+                                "outside a lock — two threads racing "
+                                "first use both run the initializer "
+                                "(double-loaded state for stateful "
+                                "singletons); guard with a module lock, "
+                                "or allowlist idempotent pure-value "
+                                "caches with that justification")
+
     def _lint_fingerprint(self, fn, qual: List[str]):
         sanctioned: Set[int] = set()
         for node in ast.walk(fn):
@@ -382,6 +506,135 @@ class _ModuleLinter:
                           "iterating a set inside a fingerprint "
                           "computation — set order is nondeterministic "
                           "across processes; sort first")
+
+
+_MUTATORS = {"append", "add", "update", "setdefault", "pop", "popitem",
+             "clear", "extend", "remove", "discard", "insert"}
+_LOCK_EXEMPT_METHODS = {"__init__", "__post_init__", "__enter__",
+                        "__exit__"}
+
+
+def _is_lock_ctor(node) -> bool:
+    """threading.Lock()/RLock() (any dotted prefix)."""
+    return (isinstance(node, ast.Call)
+            and _dotted(node.func).split(".")[-1] in ("Lock", "RLock"))
+
+
+def _self_attr_of(node) -> str:
+    """The `Y` of a `self.Y`-rooted expression, peeling subscripts
+    (`self._ops[op]` mutates `self._ops`); '' otherwise."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return ""
+
+
+def _lockish(expr) -> bool:
+    """Whether a with-context expression looks like a lock acquisition:
+    any dotted segment containing 'lock' or named '_mu'/'mutex'."""
+    d = _dotted(expr).lower()
+    return any("lock" in seg or seg in ("_mu", "mu", "mutex")
+               for seg in d.split("."))
+
+
+class _LockLinter:
+    """One lock-owning class: collect every mutation of a `self.*`
+    attribute with its under-lock state, then flag the INCONSISTENT ones
+    — attributes mutated under the class's lock somewhere (that is what
+    marks them shared) and without it elsewhere."""
+
+    def __init__(self, module: "_ModuleLinter", cls: ast.ClassDef,
+                 qual: List[str], locks: Set[str]):
+        self.module = module
+        self.cls = cls
+        self.qual = qual
+        self.locks = locks
+        # attr -> list of (locked: bool, node, method qualname)
+        self.mutations: Dict[str, List[Tuple[bool, ast.AST, str]]] = {}
+
+    def run(self):
+        for stmt in self.cls.body:
+            if not isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            exempt = (stmt.name in _LOCK_EXEMPT_METHODS
+                      or stmt.name.endswith("_locked"))
+            # a *_locked method is called under the lock by convention:
+            # its mutations are locked EVIDENCE and never findings
+            self._walk(stmt.body, stmt.name,
+                       under=stmt.name.endswith("_locked"),
+                       flaggable=not exempt)
+        protected = {a for a, ms in self.mutations.items()
+                     if any(state == "locked" for state, _, _ in ms)}
+        for attr, ms in self.mutations.items():
+            if attr not in protected:
+                continue
+            for state, node, meth in ms:
+                if state != "unlocked":
+                    continue
+                self.module._add(
+                    "lock-discipline", node, self.qual + [meth],
+                    f"`self.{attr}` is lock-protected shared state "
+                    f"(mutated under the class's lock elsewhere) but is "
+                    "mutated here without holding it — take the lock, or "
+                    "rename the method *_locked if every caller already "
+                    "holds it")
+
+    def _note(self, target, under: bool, node, meth: str, flaggable: bool):
+        attr = _self_attr_of(target)
+        if not attr or attr in self.locks:
+            return
+        # three-state: "locked" is EVIDENCE the attr is shared (and never
+        # a finding), "exempt" (__init__ & friends — single-threaded by
+        # construction contract) is neither evidence nor a finding,
+        # "unlocked" is a finding iff the attr has locked evidence
+        state = ("locked" if under
+                 else ("unlocked" if flaggable else "exempt"))
+        self.mutations.setdefault(attr, []).append((state, node, meth))
+
+    def _walk(self, body, meth: str, under: bool, flaggable: bool):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue            # nested defs: out of scope
+            if isinstance(stmt, ast.With):
+                inner = under or any(
+                    _self_attr_of(item.context_expr) in self.locks
+                    or _lockish(item.context_expr)
+                    for item in stmt.items)
+                self._walk(stmt.body, meth, inner, flaggable)
+                continue
+            if isinstance(stmt, (ast.If, ast.While, ast.For)):
+                self._walk(stmt.body, meth, under, flaggable)
+                self._walk(stmt.orelse, meth, under, flaggable)
+                continue
+            if isinstance(stmt, ast.Try):
+                for b in (stmt.body, stmt.orelse, stmt.finalbody):
+                    self._walk(b, meth, under, flaggable)
+                for h in stmt.handlers:
+                    self._walk(h.body, meth, under, flaggable)
+                continue
+            if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for t in targets:
+                    for el in (t.elts if isinstance(
+                            t, (ast.Tuple, ast.List)) else [t]):
+                        self._note(el, under, stmt, meth, flaggable)
+            elif isinstance(stmt, ast.Delete):
+                for t in stmt.targets:
+                    self._note(t, under, stmt, meth, flaggable)
+            # mutating method calls anywhere in the statement
+            # (self._ops.setdefault(...), self._q.put(...) is not in the
+            # mutator set — queues are internally synchronized)
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _MUTATORS:
+                    self._note(node.func.value, under, node, meth,
+                               flaggable)
 
 
 # ---- allowlist --------------------------------------------------------------
@@ -468,10 +721,15 @@ def main(argv=None) -> int:
         print(f)
     stale = set(allow) - used
     for key in sorted(stale):
-        print(f"NOTE: stale allowlist entry (no longer matches): "
+        # a stale entry is a FAILURE, not a note: it outlived the finding
+        # it vetted and now pre-suppresses whatever regresses into the
+        # same (path, rule, context) slot next — prune it in the change
+        # that fixed the code
+        print(f"STALE allowlist entry (matches no finding — prune it): "
               f"{'::'.join(key)}")
-    if open_findings:
-        print(f"lint_hazards: {len(open_findings)} finding(s) "
+    if open_findings or stale:
+        print(f"lint_hazards: {len(open_findings)} finding(s), "
+              f"{len(stale)} stale allowlist entr(ies) "
               f"({len(used)} allowlisted)")
         return 1
     print(f"lint_hazards: clean ({len(used)} vetted exception(s), "

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Bench-JSONL stamp linter (docs/analysis.md): the ROADMAP cross-cutting
+rule — `backend`/`n_devices`/`kernels` stamped on EVERY bench record —
+made premerge-enforced instead of review-enforced. The bench trajectory
+has silently compared CPU-fallback runs against device runs, and kernel
+backends against each other, before; a headline number missing any of
+those stamps is not comparable to anything.
+
+Two AST rules over ``benchmarks/`` and ``bench.py``:
+
+- ``missing-kernels-stamp``: every ``emit_record(...)`` / ``run_config(
+  ...)`` call site must pass ``kernels=`` explicitly. ``backend`` and
+  ``n_devices`` are stamped inside ``emit_record`` itself (checked by the
+  third rule), but the kernel choices a run dispatched are only knowable
+  at the call site — from the executed plan's per-op stamps
+  (``nds_plans.kernels_of``), the registry floor
+  (``common.registry_kernels``), or the literal ``"fallback"`` for a
+  bench that never crosses the registry (bench.py's convention: stamping
+  the registry summary would attribute kernels the run never ran).
+- ``raw-jsonl-missing-stamp``: a ``json.dumps({...literal...})`` record
+  must carry ``"backend"`` and ``"kernels"`` keys — unless it carries an
+  ``"error"`` key (failure records describe infrastructure, not
+  measurements). Dynamic (non-literal) dicts are out of static reach and
+  skipped; route them through ``emit_record`` instead.
+
+Definition sites (``benchmarks/common.py``) are exempt from the call-site
+rule — ``run_config`` forwards to ``emit_record``, which owns the
+backend/n_devices stamping this linter's third check pins down:
+
+- ``emit-record-owns-backend``: ``emit_record``'s body must assign the
+  ``"backend"`` and ``"n_devices"`` keys — the auto-stamp every other
+  rule leans on must not silently disappear.
+
+Usage::
+
+    python tools/lint_metrics.py [paths...]
+
+Exit status 1 when any finding remains. No allowlist: every record can
+and must be stamped.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+from typing import List
+
+_RECORD_FNS = {"emit_record", "run_config"}
+_EXEMPT_FILES = {"benchmarks/common.py"}
+
+
+def _last_seg(func) -> str:
+    while isinstance(func, ast.Attribute):
+        return func.attr
+    return func.id if isinstance(func, ast.Name) else ""
+
+
+def _lint_file(path: str, rel: str, findings: List[str]) -> None:
+    with open(path, "rb") as f:
+        try:
+            tree = ast.parse(f.read(), filename=path)
+        except SyntaxError as e:
+            findings.append(f"{rel}:{e.lineno}: [parse-error] {e}")
+            return
+    exempt_calls = rel in _EXEMPT_FILES
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _last_seg(node.func)
+        if name in _RECORD_FNS and not exempt_calls:
+            kw = {k.arg for k in node.keywords}
+            if "kernels" not in kw:
+                findings.append(
+                    f"{rel}:{node.lineno}: [missing-kernels-stamp] "
+                    f"{name}() without kernels= — stamp the kernel "
+                    "choices the measured run actually dispatched "
+                    "(kernels_of(res) for plan benches, "
+                    "registry_kernels(...) for registry-op benches, "
+                    "\"fallback\" for registry-free ones)")
+        elif name == "dumps" and node.args and \
+                isinstance(node.args[0], ast.Dict):
+            keys = {k.value for k in node.args[0].keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+            if "error" in keys:
+                continue        # failure record, not a measurement
+            missing = {"backend", "kernels"} - keys
+            if missing:
+                findings.append(
+                    f"{rel}:{node.lineno}: [raw-jsonl-missing-stamp] "
+                    f"json.dumps record lacks {sorted(missing)} — every "
+                    "measurement row carries backend/n_devices/kernels "
+                    "(route it through emit_record, which auto-stamps "
+                    "backend and n_devices)")
+
+
+def _check_emit_record(root: str, findings: List[str]) -> None:
+    path = os.path.join(root, "benchmarks", "common.py")
+    if not os.path.exists(path):
+        return
+    with open(path, "rb") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and \
+                node.name == "emit_record":
+            assigned = {t.slice.value
+                        for stmt in ast.walk(node)
+                        if isinstance(stmt, ast.Assign)
+                        for t in stmt.targets
+                        if isinstance(t, ast.Subscript)
+                        and isinstance(t.slice, ast.Constant)}
+            # the initial dict literal counts too
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Dict):
+                    assigned |= {k.value for k in sub.keys
+                                 if isinstance(k, ast.Constant)}
+            missing = {"backend", "n_devices"} - assigned
+            if missing:
+                findings.append(
+                    f"benchmarks/common.py:{node.lineno}: "
+                    f"[emit-record-owns-backend] emit_record no longer "
+                    f"stamps {sorted(missing)} — every downstream rule "
+                    "leans on this auto-stamp")
+            return
+    findings.append("benchmarks/common.py: [emit-record-owns-backend] "
+                    "emit_record not found")
+
+
+def main(argv=None) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ap = argparse.ArgumentParser(
+        description="bench-JSONL stamp linter (docs/analysis.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: benchmarks/ and "
+                         "bench.py)")
+    args = ap.parse_args(argv)
+    paths = args.paths or [os.path.join(repo_root, "benchmarks"),
+                           os.path.join(repo_root, "bench.py")]
+    files: List[str] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            files.append(p)
+        else:
+            for dirpath, _, names in os.walk(p):
+                files.extend(os.path.join(dirpath, n)
+                             for n in sorted(names) if n.endswith(".py"))
+    findings: List[str] = []
+    for path in sorted(files):
+        rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
+        _lint_file(path, rel, findings)
+    _check_emit_record(repo_root, findings)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"lint_metrics: {len(findings)} finding(s)")
+        return 1
+    print(f"lint_metrics: clean ({len(files)} file(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
