@@ -118,6 +118,9 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
                 io_overlap_ms: float = None,
                 mesh_axis: str = None,
                 exchange_bytes: int = None,
+                exchange_bytes_logical: int = None,
+                exchange_bytes_wire: int = None,
+                exchange_overlap_ms: float = None,
                 kernels=None,
                 stats_hits: int = None,
                 adaptive: bool = None,
@@ -140,10 +143,18 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
     override (e.g. per-phase deltas in benchmarks/adaptive_bench.py).
 
     Optional distributed fields (the `*_dist` plan variants and the
-    nightly distributed-parity stage record these): `mesh_axis` (the mesh
-    axis name the plan was sharded over) and `exchange_bytes` (total ICI
-    buffer bytes moved by the plan's exchanges, summed from the per-op
-    metrics).
+    nightly distributed-parity/exchange stages record these): `mesh_axis`
+    (the mesh axis name the plan was sharded over) and the exchange byte
+    counters summed from the per-op metrics — `exchange_bytes` (the WIRE
+    bytes the edges shipped, packed form; plan/transport.py), with
+    `exchange_bytes_wire` (same number under its explicit name) and
+    `exchange_bytes_logical` (unpacked payload) alongside so a JSONL
+    consumer can compute the compression ratio without knowing the
+    legacy field's meaning; `exchange_overlap_ms` is the async-dispatch
+    transfer/compute overlap. lint_metrics enforces that a record
+    stamping `exchange_bytes` stamps both named counters too — a wire
+    number silently compared against a logical one is the exact
+    trajectory bug the backend stamp rule exists for.
 
     Optional robustness fields (the chaos-soak stage records these, see
     benchmarks/chaos_soak.py / docs/robustness.md): `retries` (fault
@@ -189,6 +200,12 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
         rec["mesh_axis"] = mesh_axis
     if exchange_bytes is not None:
         rec["exchange_bytes"] = exchange_bytes
+    if exchange_bytes_logical is not None:
+        rec["exchange_bytes_logical"] = exchange_bytes_logical
+    if exchange_bytes_wire is not None:
+        rec["exchange_bytes_wire"] = exchange_bytes_wire
+    if exchange_overlap_ms is not None:
+        rec["exchange_overlap_ms"] = round(exchange_overlap_ms, 3)
     if retries is not None:
         rec["retries"] = retries
     if faults_injected is not None:

@@ -92,6 +92,15 @@ def _main(argv=None):
             inputs, n_rows=n_rows, iters=iters, mesh=mesh)
         assert rec["exchange_bytes"] > 0, \
             f"{name}: no exchange bytes recorded"
+        # transport honesty (plan/transport.py): both counters present,
+        # wire never exceeds logical, and neither is silently zero while
+        # the other moves — a pass-through regression (packing quietly
+        # disabled, or wire mis-attributed) trips here before it can
+        # poison the JSONL trajectory
+        assert rec["exchange_bytes_wire"] == rec["exchange_bytes"], name
+        assert 0 < rec["exchange_bytes_wire"] <= \
+            rec["exchange_bytes_logical"], \
+            f"{name}: wire/logical byte counters inconsistent ({rec})"
         assert rec["gathers"] == 1, \
             f"{name}: expected a single sink gather, got {rec['gathers']}"
         assert res.optimizer["exchanges"]["gather"] == 1, name

@@ -1,0 +1,149 @@
+"""Nightly exchange-transport stage (ci/nightly.sh, docs/distributed.md
+#transport).
+
+Runs NDS q5 and q72 through the full-plan SPMD distributed tier on a
+4-device simulated CPU mesh with the packed wire format
+(plan/transport.py) and async exchange dispatch forced ON, asserting:
+
+- EXACT result parity per query, four ways: packed+async vs the
+  single-device eager tier (inside run_plan_distributed), then
+  packed-sync and pack-off runs compared against the packed+async
+  result (the transport layer may never change a result);
+- compression is REAL: on at least one exchange edge the wire bytes are
+  < 0.8x the logical bytes, and no edge's wire ever exceeds its logical;
+- the certifier cross-check holds: every planned Exchange edge's wire
+  bytes sit at or under its certified per-edge payload bound
+  (`footprint.check_observed` — the PR 12 bounds became a runtime
+  inequality);
+- async dispatch OVERLAPS: summed exchange overlap-ms > 0 on at least
+  one query (the transfer ran while the walk executed other operators);
+- JSONL rows carry both byte counters plus overlap-ms (run through
+  `nds_plans.run_plan_distributed`, so backend/n_devices/kernels stamps
+  ride along as always).
+
+Like distributed_parity.py this runs with the stats store scoped OFF so
+the static planner's broadcast+shuffle mix is what the edges exercise.
+"""
+import sys
+
+sys.path.insert(0, ".")
+
+import os  # noqa: E402
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = \
+        (flags + " --xla_force_host_platform_device_count=8").strip()
+
+from benchmarks.common import parse_args                     # noqa: E402
+from benchmarks.nds_plans import (dist_mesh, q5_inputs,      # noqa: E402
+                                  q5_plan, q72_inputs, q72_plan,
+                                  run_plan_distributed)
+
+N_DEVICES = 4
+RATIO_GATE = 0.8        # wire <= 0.8x logical on >= 1 edge (per ISSUE 14)
+
+
+def _forced(**env):
+    import contextlib
+
+    @contextlib.contextmanager
+    def cm():
+        prev = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            yield
+        finally:
+            for k, p in prev.items():
+                if p is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = p
+    return cm()
+
+
+def main(argv=None):
+    from spark_rapids_tpu.plan import stats as stats_mod
+    with stats_mod.scoped_store(None):
+        return _main(argv)
+
+
+def _main(argv=None):
+    from spark_rapids_tpu.analysis.footprint import check_observed
+
+    args = parse_args(argv)
+    n = max(int(100_000 * args.scale), 10_000)
+    iters = min(args.iters, 3)
+
+    from benchmarks.bench_nds_q5 import build_tables as bt5
+    from benchmarks.bench_nds_q72 import build_tables as bt72
+
+    mesh = dist_mesh(N_DEVICES)
+    assert mesh is not None, \
+        f"exchange bench needs >= {N_DEVICES} simulated devices"
+
+    cases = {
+        "q5": (q5_plan(), q5_inputs(*bt5(n, seed=3))),
+        "q72": (q72_plan(), q72_inputs(*bt72(n, seed=5))),
+    }
+    best_ratio = 1.0
+    total_overlap = 0.0
+    for name, (plan, inputs) in cases.items():
+        n_rows = sum(t.num_rows for t in inputs.values())
+        with _forced(SPARK_RAPIDS_TPU_EXCHANGE_PACK="on",
+                     SPARK_RAPIDS_TPU_EXCHANGE_ASYNC="on"):
+            rec, res = run_plan_distributed(
+                f"exchange_bench_{name}", {"num_rows": n_rows}, plan,
+                inputs, n_rows=n_rows, iters=iters, mesh=mesh)
+        packed = res.table.to_pydict()
+
+        # per-edge honesty + the certifier inequality
+        edges = [m for m in res.metrics.values() if m.exchange_how]
+        assert edges, f"{name}: no exchange edges observed"
+        for m in edges:
+            assert m.exchange_bytes <= m.exchange_bytes_logical, \
+                (f"{name}: {m.label} wire {m.exchange_bytes} > logical "
+                 f"{m.exchange_bytes_logical}")
+        ratios = [m.exchange_bytes / m.exchange_bytes_logical
+                  for m in edges if m.exchange_bytes_logical]
+        best_ratio = min([best_ratio, *ratios])
+        assert res.cert is not None, f"{name}: no resource cert stamped"
+        bad = check_observed(res.cert, res)
+        assert bad is None, f"{name}: certifier cross-check failed: {bad}"
+        assert rec["exchange_bytes_wire"] == rec["exchange_bytes"], name
+        assert rec["exchange_bytes_wire"] <= rec["exchange_bytes_logical"]
+        total_overlap += rec["exchange_overlap_ms"]
+
+        # transport must never change a result: packed-sync == packed
+        # +async == pack-off (run_plan_distributed already asserted
+        # packed+async == the single-device eager tier)
+        from spark_rapids_tpu.plan import PlanExecutor
+        with _forced(SPARK_RAPIDS_TPU_EXCHANGE_PACK="on",
+                     SPARK_RAPIDS_TPU_EXCHANGE_ASYNC="off"):
+            sync = PlanExecutor(mesh=mesh).execute(plan, inputs)
+        assert not sync.degraded, f"{name}: packed-sync run degraded"
+        assert sync.table.to_pydict() == packed, \
+            f"{name}: async dispatch changed the result"
+        with _forced(SPARK_RAPIDS_TPU_EXCHANGE_PACK="off",
+                     SPARK_RAPIDS_TPU_EXCHANGE_ASYNC="off"):
+            off = PlanExecutor(mesh=mesh).execute(plan, inputs)
+        assert not off.degraded, f"{name}: pack-off run degraded"
+        assert off.table.to_pydict() == packed, \
+            f"{name}: packing changed the result"
+        for m in off.metrics.values():
+            if m.exchange_how:
+                assert m.exchange_bytes == m.exchange_bytes_logical, \
+                    f"{name}: pack off but wire != logical on {m.label}"
+
+    assert best_ratio <= RATIO_GATE, \
+        (f"no exchange edge compressed below {RATIO_GATE}x logical "
+         f"(best ratio {best_ratio:.3f}) — packing is silently "
+         "pass-through everywhere")
+    assert total_overlap > 0.0, \
+        "async dispatch produced zero exchange/compute overlap"
+    print(f"exchange transport OK (best wire/logical {best_ratio:.3f}, "
+          f"overlap {total_overlap:.1f} ms)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -342,11 +342,17 @@ def run_plan_distributed(bench: str, axes: dict, plan, inputs, *,
         r = ex.execute(plan, inputs)
         return [c.data for c in r.table.columns]
 
+    wire = sum(m.exchange_bytes for m in res.metrics.values())
     rec = run_config(
         bench, dict(axes), prun, (), n_rows=n_rows, iters=iters,
         jit=False, impl="plan_distributed", mesh_axis=mesh_axis,
         kernels=kernels_of(res),
-        exchange_bytes=sum(m.exchange_bytes for m in res.metrics.values()),
+        exchange_bytes=wire,
+        exchange_bytes_wire=wire,
+        exchange_bytes_logical=sum(m.exchange_bytes_logical
+                                   for m in res.metrics.values()),
+        exchange_overlap_ms=sum(m.exchange_overlap_ms
+                                for m in res.metrics.values()),
         mesh_devices=int(mesh.shape[mesh_axis]),
         exchanges_planned=opt.get("exchanges", {}),
         exchanges_elided=opt.get("exchanges_elided", 0),

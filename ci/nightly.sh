@@ -48,6 +48,14 @@ JAX_PLATFORMS=cpu python benchmarks/streaming_scan.py --scale 0.5 --cpu
 # fields
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python benchmarks/distributed_parity.py --scale 0.2 --cpu
+# exchange transport (docs/distributed.md#transport): NDS q5/q72 on the
+# 4-device mesh with packing + async dispatch forced on — exact parity
+# packed vs pack-off vs single-device, wire <= logical on every edge with
+# wire <= 0.8x logical on at least one, wire <= the certified per-edge
+# bound (footprint.check_observed), nonzero exchange/compute overlap-ms,
+# and JSONL rows carrying exchange_bytes_wire/_logical/_overlap_ms
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python benchmarks/exchange_bench.py --scale 0.2 --cpu
 # kernel-registry gate (docs/kernels.md): per-kernel parity (each Pallas
 # kernel FORCED against its XLA fallback — interpret mode on CPU) plus the
 # NDS q5/q72 capped tier registry-on vs forced-fallback with exact parity;

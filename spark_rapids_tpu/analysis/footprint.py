@@ -25,7 +25,16 @@ result as a :class:`ResourceCert`:
 - **exchange bytes**: hash edges move each row at most once, broadcast
   replicates the relation onto every other peer, gather collects it —
   `exchange_bytes_hi` bounds the payload per planned Exchange edge
-  (ROADMAP item 5's honest bytes-on-wire accounting, statically).
+  (ROADMAP item 5's honest bytes-on-wire accounting, statically). The
+  bound models the WIRE form the distributed tier actually ships
+  (plan/transport.py): a hash edge's key columns ride their 64-bit
+  order-preserving word encoding (8 B per word, plus a null-flag word
+  when nullable) while value columns ship at most their unpacked
+  column width; a hash edge whose sole consumer is a keyed aggregate
+  fuses into the two-phase groupby and ships per-group int64 partials
+  instead, so such edges bound by the larger of the two payload models.
+  The runtime's observed `exchange_bytes` (wire) must stay at or under
+  this bound on every edge — `check_observed` enforces the inequality.
 
 Soundness contract (machine-checked): for every operator of every
 executed plan, ``rows_lo <= observed rows_out <= rows_hi``, and on the
@@ -263,6 +272,53 @@ def _rows_interval(node: PlanNode, kids: List[Tuple[int, Optional[int]]],
     return los[0] if los else 0, his[0] if his else None
 
 
+def _key_words(dt: Optional[dtypes.DType], nullable: bool) -> Optional[int]:
+    """64-bit words one key column rides through a hash exchange
+    (parallel/keys.py encoding: decimal128 = 2 data words, every other
+    fixed-width kind = 1, plus a null-flag word when nullable); None for
+    kinds with no distributed key encoding (strings/nested/unknown)."""
+    if dt is None or dt.is_string or dt.is_nested:
+        return None
+    words = 2 if dt.kind == dtypes.Kind.DECIMAL128 else 1
+    return words + (1 if nullable else 0)
+
+
+def _hash_edge_row_bytes(node: Exchange, schema, ctypes,
+                         cnull) -> Optional[int]:
+    """Wire bytes per row of a standalone hash exchange: key columns as
+    8-byte order-preserving words (the partition-hash input, never
+    narrowed), every other column at most its unpacked width."""
+    total = 0
+    keyset = set(node.keys)
+    for k in node.keys:
+        w = _key_words(ctypes.get(k), cnull.get(k, True))
+        if w is None:
+            return None
+        total += 8 * w
+    for cname in (schema or ()):
+        if cname in keyset:
+            continue
+        w = _col_width(ctypes.get(cname))
+        if w is None:
+            return None
+        total += w
+    return total
+
+
+def _partial_row_bytes(agg: HashAggregate, ctypes, cnull) -> Optional[int]:
+    """Wire bytes per shipped GROUP of a fused aggregate exchange: the
+    two-phase program's all-to-all moves one int64 per key word and per
+    agg partial (groups <= input rows, so rows_hi x this width is a
+    sound payload bound)."""
+    total_words = 0
+    for k in agg.keys:
+        w = _key_words(ctypes.get(k), cnull.get(k, True))
+        if w is None:
+            return None
+        total_words += w
+    return 8 * (total_words + len(agg.aggs))
+
+
 def _agg_widths(node: HashAggregate, child_types) -> Optional[int]:
     """Output bytes/row of a HashAggregate: group keys keep their column
     widths; aggregate outputs certify at the 64-bit accumulator width
@@ -289,6 +345,10 @@ def certify_nodes(nodes: List[PlanNode], *, bound=None, bound_rows=None,
     payloads (1 = single chip, exchanges move nothing)."""
     schemas, _ = _propagate_schemas(nodes, bound, strict=False)
     types = column_types(nodes, schemas, input_dtypes or {})
+    parents: Dict[int, List[PlanNode]] = {}
+    for nd in nodes:
+        for ch in nd.children:
+            parents.setdefault(id(ch), []).append(nd)
     # nullability walk, conservative: unknown -> True (nullable)
     nullable: Dict[int, Dict[str, bool]] = {}
     for node in nodes:
@@ -363,11 +423,28 @@ def certify_nodes(nodes: List[PlanNode], *, bound=None, bound_rows=None,
 
         # exchange payload per planned edge (docs/distributed.md): hash
         # moves each row at most once; broadcast lands one extra copy on
-        # every other peer; gather collects the whole relation
+        # every other peer; gather collects the whole relation. The
+        # model is the WIRE form (module docstring): hash edges price
+        # key columns as their 8-byte word encoding, and a hash edge
+        # fused into the keyed aggregate above it ships per-group int64
+        # partials — bound by the larger payload model, covering both
+        # runtime paths.
         exchange: Optional[int] = 0
         if isinstance(node, Exchange) and n_peers > 1:
             child_out = kid_bounds[0].out_bytes_hi
-            if node.how == "hash" or node.how == "gather":
+            if node.how == "hash":
+                cid = id(node.children[0])
+                ctypes = types.get(cid) or {}
+                cnull = nullable.get(cid, {})
+                width = _hash_edge_row_bytes(node, schemas.get(id(node)),
+                                             ctypes, cnull)
+                par = parents.get(id(node), [])
+                if width is not None and len(par) == 1 and \
+                        isinstance(par[0], HashAggregate) and par[0].keys:
+                    pw = _partial_row_bytes(par[0], ctypes, cnull)
+                    width = None if pw is None else max(width, pw)
+                exchange = _mul(hi, width)
+            elif node.how == "gather":
                 exchange = child_out
             elif node.how == "broadcast":
                 exchange = _mul(child_out, n_peers - 1)
@@ -406,8 +483,15 @@ def check_observed(cert: ResourceCert, result) -> Optional[str]:
     tiers), observed bytes at or under the certified byte bound on the
     eager tier for non-degraded ops (capped buffers pad to caps;
     degraded ops re-ran on a different tier than the cert sized).
+    On a distributed run, every planned Exchange edge's observed WIRE
+    bytes (the packed payload the edge shipped, plan/transport.py) must
+    also sit at or under the certified per-edge payload bound — the
+    `wire <= certified hi` inequality the transport layer is audited
+    against (the cert must have been built with the run's n_peers, as
+    `PlanExecutor.execute` does for the cert it stamps on the result).
     Returns the first violation as a string, None when sound — fuzz
-    property 5 and the nightly footprint gate both call this."""
+    property 5, the nightly footprint gate, and the exchange-transport
+    gate (benchmarks/exchange_bench.py) all call this."""
     for lbl, m in result.metrics.items():
         b = cert.by_label.get(lbl)
         if b is None:
@@ -416,11 +500,21 @@ def check_observed(cert: ResourceCert, result) -> Optional[str]:
                 b.rows_hi is not None and m.rows_out > b.rows_hi):
             return (f"{lbl}: observed rows {m.rows_out} outside "
                     f"certified [{b.rows_lo}, {b.rows_hi}]")
-        if result.mode == "eager" and not m.degraded \
+        # mesh-resident ops (n_peers stamped) pad buffers to the mesh
+        # width and exchange slack, so their bytes_out measures padding,
+        # not live data (module docstring) — rows and WIRE bytes remain
+        # comparable there
+        if result.mode == "eager" and not m.degraded and not m.n_peers \
                 and b.out_bytes_hi is not None \
                 and m.bytes_out > b.out_bytes_hi:
             return (f"{lbl}: observed bytes {m.bytes_out} > certified "
                     f"{b.out_bytes_hi}")
+        if m.kind == "Exchange" and not m.degraded \
+                and m.exchange_bytes \
+                and b.exchange_bytes_hi is not None \
+                and m.exchange_bytes > b.exchange_bytes_hi:
+            return (f"{lbl}: observed wire bytes {m.exchange_bytes} > "
+                    f"certified exchange bound {b.exchange_bytes_hi}")
     return None
 
 

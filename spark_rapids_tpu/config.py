@@ -31,6 +31,9 @@ equivalents, all read at use time (not import time) so tests can monkeypatch:
 | SPARK_RAPIDS_TPU_CERT_ADMISSION  | reject | what an over-budget certified plan does at admission: reject (raise ResourceAdmissionError naming the operator, before any compilation) / degrade (run on the CPU tier) |
 | SPARK_RAPIDS_TPU_CERT_SEED       | on   | capped tier: tighten cold-run starting capacities to the certified hi-bound and ceiling the escalation ladder at it (active only with the stats store on — stats off stays byte-identical static) |
 | SPARK_RAPIDS_TPU_DIST_SLACK      | 2.0  | distributed tier: initial per-bucket slack factor for hash/range exchanges (grows geometrically on overflow) |
+| SPARK_RAPIDS_TPU_EXCHANGE_PACK   | on   | exchange transport packing (plan/transport.py, docs/distributed.md#transport): ship packed columnar wire planes across hash/broadcast/gather edges; "off" restores the byte-identical legacy per-column payload |
+| SPARK_RAPIDS_TPU_EXCHANGE_CODECS | auto | codec families the transport layer may choose from: auto (for,dict,rle,bitpack), none (layout-only pass-through), or a comma subset |
+| SPARK_RAPIDS_TPU_EXCHANGE_ASYNC  | off  | async exchange dispatch: an Exchange's pack+transfer runs on a worker thread and overlaps downstream compute until its consumer resolves it (overlap-ms on OperatorMetrics) |
 | SPARK_RAPIDS_TPU_VERIFY_PLANS    | 0    | static plan verifier gate (analysis/verifier.py): 1 verifies every plan pre-execution and every optimizer rule's output; on in tests (conftest), off in production |
 | SPARK_RAPIDS_TPU_STATS           | on   | per-fingerprint operator-stats store (plan/stats.py, docs/adaptive.md): observed cardinalities drive join build sides / exchange modes, cap seeding, chunk sizing, and kernel tie-breaks; "off" restores fully static decisions |
 | SPARK_RAPIDS_TPU_STATS_CAPACITY  | 256  | stats store LRU bound: per-(backend, fingerprint) plan entries retained (subtree/kernel tables scale off this) |
@@ -232,6 +235,49 @@ def dist_slack() -> float:
     raises the overflow flag and the executor retries with geometrically
     grown slack (SplitAndRetry contract, parallel/autoretry.py)."""
     return _float_env("SPARK_RAPIDS_TPU_DIST_SLACK", 2.0)
+
+
+def exchange_pack() -> bool:
+    """Exchange transport packing (plan/transport.py, docs/distributed.md
+    #transport): when on, hash/broadcast/gather exchange payloads ship as
+    dense packed planes (coalesced word planes, bit-packed validity,
+    cheap per-column encodings) and unpack on the receiving shard;
+    metrics then split logical vs wire bytes per edge. "off" restores
+    the byte-identical legacy payload layout (wire == logical). Same
+    strict-typo policy as the kernel selectors — a typo must not
+    silently change what a bench's wire numbers measured."""
+    v = os.environ.get("SPARK_RAPIDS_TPU_EXCHANGE_PACK", "on")
+    if v not in ("on", "off"):
+        raise ValueError(
+            f"SPARK_RAPIDS_TPU_EXCHANGE_PACK={v!r}: expected on or off")
+    return v == "on"
+
+
+def exchange_codecs() -> frozenset:
+    """Codec families the exchange transport may choose from (selection
+    per column stays by cheap inspection with strict pass-through):
+    "auto" allows the full catalog (for, dict, rle, bitpack), "none"
+    keeps the packed layout but no per-column encodings, a comma list
+    restricts to a subset. Unknown names raise (strict-typo policy)."""
+    from .plan.transport import resolve_codecs
+    return resolve_codecs(
+        os.environ.get("SPARK_RAPIDS_TPU_EXCHANGE_CODECS", "auto"))
+
+
+def exchange_async() -> bool:
+    """Async exchange dispatch (plan/distributed.py): when on, an
+    Exchange node's pack+transfer runs on a worker thread and the plan
+    walk continues — the transfer overlaps downstream operators' compute
+    until the exchange's consumer resolves it (the PR 4 prefetch-thread
+    shape applied to the exchange boundary; measured overlap-ms lands on
+    the edge's OperatorMetrics). Off (default) keeps the fully
+    synchronous walk — byte-identical behavior and fault attribution.
+    Same strict-typo policy as the kernel selectors."""
+    v = os.environ.get("SPARK_RAPIDS_TPU_EXCHANGE_ASYNC", "off")
+    if v not in ("on", "off"):
+        raise ValueError(
+            f"SPARK_RAPIDS_TPU_EXCHANGE_ASYNC={v!r}: expected on or off")
+    return v == "on"
 
 
 def verify_plans() -> bool:

@@ -52,11 +52,27 @@ distributed aggregate's output by its group keys to match the local
 sort-based kernel row for row; Sort's own output is globally ordered and
 gathers in place (ties may order differently than the local stable sort
 when the sort keys do not totally order the rows).
+
+Transport (plan/transport.py, docs/distributed.md#transport): with
+SPARK_RAPIDS_TPU_EXCHANGE_PACK on (default), every exchange payload
+ships in packed wire form — FOR-narrowed integer planes and bit-packed
+validity inside the collectives, dictionary/RLE on the host-materialized
+broadcast build side, packed planes on the device→host gather pull —
+and unpacks on the receiving side. Byte accounting is per edge, live
+payload only, each edge counted once (broadcast x (n_peers-1)):
+`exchange_bytes` is the wire form, `exchange_bytes_logical` the
+unpacked per-column payload, and both stay at or under the certifier's
+per-edge bound (analysis/footprint.py). SPARK_RAPIDS_TPU_EXCHANGE_ASYNC
+dispatches an Exchange's pack+transfer on a worker thread (`PendingRel`)
+so the transfer overlaps downstream operators' compute until a consumer
+resolves it — the PR 4 prefetch shape at the exchange boundary; a
+transfer fault then surfaces (and degrades) at the consuming operator.
 """
 from __future__ import annotations
 
 import dataclasses
-import math
+import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -69,6 +85,7 @@ from ..columnar import Column, Table
 from ..parallel.keys import (KeySpec, _ONE_WORD_KINDS, decode_key_columns,
                              encode_key_column)
 from ..utils.lru import LruDict
+from . import transport
 from .nodes import (Exchange, Filter, FusedSelect, HashAggregate, HashJoin,
                     Limit, PlanNode, Project, Scan, Sort, TopK, Union)
 
@@ -78,17 +95,25 @@ _DIST_AGGS = ("sum", "count", "min", "max", "size")
 
 # jitted distributed primitives, keyed by (name, mesh, axis, static params):
 # an eager shard_map re-traces AND re-compiles per call; one bounded cache
-# for the whole process keeps repeat executions at dispatch cost
+# for the whole process keeps repeat executions at dispatch cost.
+# LruDict's get (check-then-pop) and eviction loop are not thread-safe and
+# async exchange workers (PendingRel) hit this cache concurrently — one
+# lock covers every shared-memo access on the distributed path
 _JIT_PRIMS = LruDict(256)
+_MEMO_LOCK = threading.Lock()
 
 
 def _jitted(key, builder):
     """Bounded cache of compiled primitive callables; `builder()` returns
-    the final (already jit-wrapped) function."""
-    fn = _JIT_PRIMS.get(key)
+    the final (already jit-wrapped) function. Safe under concurrent async
+    exchange workers: a lost race builds one redundant (cheap, un-traced)
+    wrapper, never corrupts the cache."""
+    with _MEMO_LOCK:
+        fn = _JIT_PRIMS.get(key)
     if fn is None:
         fn = builder()
-        _JIT_PRIMS[key] = fn
+        with _MEMO_LOCK:
+            _JIT_PRIMS[key] = fn
     return fn
 
 
@@ -138,12 +163,7 @@ class ShardedRel:
         return self.table.num_rows
 
     def sharding_str(self, n_peers: int) -> str:
-        if self.replicated:
-            return f"replicated@{n_peers}"
-        if self.part:
-            keys = min(self.part)   # deterministic pick for display
-            return f"hash[{','.join(keys)}]@{n_peers}"
-        return f"rows@{n_peers}"
+        return _sharding_str(self.part, self.replicated, n_peers)
 
     def to_local_table(self) -> Table:
         """Gather to one device and compact to the live rows (restoring
@@ -168,6 +188,130 @@ class ShardedRel:
                                   ascending=[True] * len(self.order_keys))
         self._local = t
         return t
+
+
+def _sharding_str(part: frozenset, replicated: bool, n_peers: int) -> str:
+    if replicated:
+        return f"replicated@{n_peers}"
+    if part:
+        keys = min(part)   # deterministic pick for display
+        return f"hash[{','.join(keys)}]@{n_peers}"
+    return f"rows@{n_peers}"
+
+
+class PendingRel:
+    """A ShardedRel still in flight on an exchange worker thread
+    (SPARK_RAPIDS_TPU_EXCHANGE_ASYNC): the plan walk continues past the
+    Exchange node while pack+transfer run on the thread, and the first
+    consumer `resolve()`s — the transfer wall that ran while the main
+    thread was NOT blocked waiting here is the edge's measured
+    `exchange_overlap_ms`. Placement facts (`part`/`replicated`) are
+    known statically so the metric loop stamps `sharding` without
+    forcing a wait; every data accessor resolves first. A transfer
+    error raises at the consumer (the async fault-attribution caveat in
+    docs/distributed.md#transport), and the consumer's retry loop gets
+    REAL re-execution: each later resolve re-runs the exchange
+    synchronously instead of re-raising a cached error."""
+
+    pending = True
+
+    def __init__(self, fn, metric, nbytes_fn,
+                 part: frozenset = frozenset(), replicated: bool = False):
+        self._fn = fn
+        self._metric = metric
+        self._nbytes_fn = nbytes_fn
+        self.part = part
+        self.replicated = replicated
+        self._result = None
+        self._err = None
+        self._t0 = self._t1 = 0.0
+        self._resolved = False
+
+        def work():
+            self._t0 = time.perf_counter()
+            try:
+                out = fn()
+                # the transfer must COMPLETE on the thread — otherwise
+                # "async" would just defer the device work to the
+                # consumer and the overlap would be fiction
+                jax.block_until_ready([c.data for c in out.table.columns])
+                self._result = out
+            except BaseException as e:    # surfaces at the consumer
+                self._err = e
+            finally:
+                self._t1 = time.perf_counter()
+
+        self._thread = threading.Thread(
+            target=work, daemon=True, name="spark-rapids-tpu-exchange")
+        self._thread.start()
+
+    def _stamp(self, dur: float) -> None:
+        m = self._metric
+        m.wall_ms = dur * 1e3
+        m.rows_out = self._result.num_rows
+        m.bytes_out = self._nbytes_fn(self._result.table)
+
+    def resolve(self) -> "ShardedRel":
+        if not self._resolved:
+            w0 = time.perf_counter()
+            self._thread.join()
+            blocked = time.perf_counter() - w0
+            self._resolved = True
+            dur = self._t1 - self._t0
+            self._metric.exchange_overlap_ms = max(0.0, dur - blocked) * 1e3
+            if self._result is not None:
+                self._stamp(dur)
+        if self._result is None:
+            # the worker thread failed. Raise the original error ONCE on
+            # the consuming thread; every later resolve (the consumer's
+            # fault-retry loop re-entering exec_node) RE-RUNS the
+            # exchange synchronously here, so transient faults get real
+            # re-execution semantics instead of a cached error that
+            # makes every retry futile
+            err, self._err = self._err, None
+            if err is not None:
+                raise err
+            t0 = time.perf_counter()
+            out = self._fn()
+            jax.block_until_ready([c.data for c in out.table.columns])
+            self._result = out
+            self._stamp(time.perf_counter() - t0)
+        return self._result
+
+    def sharding_str(self, n_peers: int) -> str:
+        return _sharding_str(self.part, self.replicated, n_peers)
+
+    # -- data accessors force resolution -------------------------------------
+    @property
+    def table(self):
+        return self.resolve().table
+
+    @property
+    def valid(self):
+        return self.resolve().valid
+
+    @property
+    def columns(self):
+        return self.resolve().columns
+
+    @property
+    def num_rows(self) -> int:
+        return self.resolve().num_rows
+
+    @property
+    def padded_rows(self) -> int:
+        return self.resolve().padded_rows
+
+    @property
+    def order_keys(self):
+        return self.resolve().order_keys
+
+    def to_local_table(self) -> Table:
+        return self.resolve().to_local_table()
+
+
+def _resolve_rel(c):
+    return c.resolve() if getattr(c, "pending", False) else c
 
 
 def table_shardable(t: Table) -> bool:
@@ -386,6 +530,11 @@ class DistContext:
         self.n_peers = self.mesh.shape[self.axis]
         self.plan = plan
         self.slack = config.dist_slack()
+        # transport knobs (plan/transport.py), snapshotted per execution:
+        # pack off restores the byte-identical legacy payload layout
+        self.pack = config.exchange_pack()
+        self.codecs = config.exchange_codecs() if self.pack else frozenset()
+        self.async_on = config.exchange_async()
         self.spec = NamedSharding(self.mesh, P(self.axis))
         self.rep_spec = NamedSharding(self.mesh, P())
         parents: Dict[int, List[PlanNode]] = {}
@@ -414,7 +563,8 @@ class DistContext:
         return (self.plan.fingerprint, self._node_index[id(node)], tag)
 
     def _caps(self, node, tag: str, defaults: Dict) -> Dict:
-        memo = self.ex._dist_caps_memo.get(self._memo_key(node, tag))
+        with _MEMO_LOCK:
+            memo = self.ex._dist_caps_memo.get(self._memo_key(node, tag))
         caps = dict(defaults)
         for k, v in (memo or {}).items():
             if k in caps:
@@ -433,7 +583,9 @@ class DistContext:
                                          self.ex.max_cap_attempts)
         if m is not None:
             m.escalations += attempts[0] - 1
-        self.ex._dist_caps_memo[self._memo_key(node, tag)] = dict(final)
+        with _MEMO_LOCK:
+            self.ex._dist_caps_memo[self._memo_key(node, tag)] = \
+                dict(final)
         return out
 
     # -- helpers -------------------------------------------------------------
@@ -443,9 +595,15 @@ class DistContext:
         return shard_table(self.mesh, self.axis, rel_or_table, part=part)
 
     def localize(self, rel_or_table) -> Table:
+        rel_or_table = _resolve_rel(rel_or_table)
         if isinstance(rel_or_table, ShardedRel):
             return rel_or_table.to_local_table()
         return rel_or_table
+
+    @staticmethod
+    def _nbytes(table: Table) -> int:
+        from ..runtime.admission import operand_nbytes
+        return operand_nbytes(table)
 
     def _put(self, arr):
         return jax.device_put(arr, self.spec)
@@ -454,22 +612,20 @@ class DistContext:
         per_shard = max(max(padded_lens, default=1) // self.n_peers, 1)
         return max(64, 2 * per_shard)
 
-    @staticmethod
-    def _exchange_bytes(arrays, n_peers: int, cap: int) -> int:
-        """Buffer bytes one slack-capacity all-to-all ships: every shard
-        sends n_peers buckets of `cap` slot-rows per payload."""
-        return sum(a.dtype.itemsize for a in arrays) * n_peers * n_peers * cap
-
     # -- node dispatch -------------------------------------------------------
     def exec_node(self, node, childs, inputs, schemas, m, metrics):
         """Execute one node: distributed when it has a form and its
         children allow it, local otherwise (gathering sharded children —
-        the graceful boundary). Returns a ShardedRel or a Table."""
+        the graceful boundary). Returns a ShardedRel, a PendingRel (async
+        exchange in flight), or a Table. In-flight child exchanges
+        resolve HERE — the consumer boundary is where the async overlap
+        window closes."""
+        childs = [_resolve_rel(c) for c in childs]
         out = self._try_dist(node, childs, inputs, schemas, m, metrics)
         if out is None:
             local = [self.localize(c) for c in childs]
             out = self.ex._exec_eager_node(node, local, inputs, schemas, m)
-        if isinstance(out, ShardedRel):
+        if isinstance(out, (ShardedRel, PendingRel)):
             m.sharding = out.sharding_str(self.n_peers)
             m.n_peers = self.n_peers
         elif any(isinstance(c, ShardedRel) for c in childs):
@@ -558,76 +714,207 @@ class DistContext:
                     table_shardable(c) and c.num_rows:
                 # a locally-computed small build side can still feed a
                 # distributed broadcast join: replicate it directly
+                if self.async_on:
+                    return PendingRel(
+                        lambda: self._replicate_local(c, m), m,
+                        self._nbytes, replicated=True)
                 return self._replicate_local(c, m)
             return None       # single-chip semantics: Exchange is a no-op
         if node.how == "identity":
             return c
         if node.how == "gather":
-            t = c.to_local_table()
-            m.exchange_how = "gather"
-            m.exchange_bytes = sum(col.data.nbytes
-                                   for col in c.table.columns)
-            return t
+            return self._gather(c, m)
         if node.how == "broadcast":
+            if c.replicated:
+                return c
+            if self.async_on:
+                return PendingRel(lambda: self._broadcast(c, m), m,
+                                  self._nbytes, replicated=True)
             return self._broadcast(c, m)
         if id(node) in self.fused_exchanges:
             return c          # defers into the aggregate above (fusion)
+        if self.async_on:
+            # the has-a-distributed-form checks must fail HERE,
+            # synchronously: a NotImplementedError raised on the worker
+            # thread would surface at the consumer, outside _try_dist's
+            # graceful local-fallback net
+            if _key_specs(c.table, list(node.keys)) is None or \
+                    not table_shardable(c.table):
+                raise NotImplementedError
+            return PendingRel(lambda: self._repartition(node, c, m), m,
+                              self._nbytes,
+                              part=frozenset({tuple(node.keys)}))
         return self._repartition(node, c, m)
 
-    def _replicate_local(self, t: Table, m) -> ShardedRel:
+    def _edge(self, m, how: str, logical: int, wire: int, codec: str,
+              copies: int = 1):
+        """Stamp one exchange edge's movement on a metric row: logical =
+        unpacked per-column payload, wire = packed bytes actually shipped
+        (== logical with packing off). Live payload only, each edge
+        counted once; broadcast passes copies = n_peers-1."""
+        m.exchange_how = how
+        m.exchange_bytes_logical += logical * copies
+        m.exchange_bytes += wire * copies
+        if codec:
+            m.exchange_codecs = (m.exchange_codecs + ";" + codec
+                                 if m.exchange_codecs else codec)
+
+    @staticmethod
+    def _reset_edge(m):
+        """A retried (or re-run) exchange attempt RE-DESCRIBES its edge:
+        the metric must show the execution that produced the output, not
+        a sum over failed attempts."""
+        m.exchange_bytes = 0
+        m.exchange_bytes_logical = 0
+        m.exchange_codecs = ""
+
+    def _gather(self, c: ShardedRel, m) -> Table:
+        """The sink/boundary collect. Packed: static wire planes compute
+        on the mesh, ONE narrow pull per plane crosses to host, and the
+        receiving side decodes + compacts (plan/transport.py); the result
+        caches on the rel like to_local_table so DAG-shared consumers
+        gather once — a cache-served gather moves NOTHING and reports
+        zero bytes (the first crossing carried the payload)."""
+        self._reset_edge(m)
+        if c._local is not None:
+            m.exchange_how = "gather"
+            return c._local
+        live = c.num_rows
+        cols = list(c.table.columns)
+        logical = live * transport.logical_row_bytes(cols)
+        if self.pack:
+            t, wire_row, codec = self._gather_packed(c)
+            self._edge(m, "gather", logical, live * wire_row, codec)
+        else:
+            t = c.to_local_table()
+            self._edge(m, "gather", logical, logical, "")
+        return t
+
+    def _gather_packed(self, c: ShardedRel):
+        names = list(c.table.names)
+        dp = transport.pack_device(list(c.table.columns), names, c.valid,
+                                   self.codecs)
+        mask_plane, n = transport.pack_bits_device(c.valid)
+        planes = [np.asarray(p) for p in dp.planes]
+        mask = transport.unpack_bits_np(np.asarray(mask_plane), n)
+        idx = np.nonzero(mask)[0]
+        decoded = transport.unpack_device_np(planes, dp)
         cols = []
-        for c in t.columns:
-            validity = c.validity
-            if validity is not None:
-                validity = jax.device_put(validity, self.rep_spec)
+        for src, (data, validity) in zip(c.table.columns, decoded):
+            v = None if validity is None else jnp.asarray(validity[idx])
             cols.append(dataclasses.replace(
-                c, data=jax.device_put(c.data, self.rep_spec),
-                validity=validity))
-        valid = jax.device_put(jnp.ones((t.num_rows,), bool), self.rep_spec)
-        m.exchange_how = "broadcast"
-        m.exchange_bytes = sum(c.data.nbytes for c in t.columns) \
-            * self.n_peers
+                src, data=jnp.asarray(data[idx]), validity=v,
+                length=int(idx.shape[0])))
+        t = Table(cols, names=names)
+        if c.order_keys:
+            from .executor import _ops
+            t = _ops().sort_table(t, key_names=list(c.order_keys),
+                                  ascending=[True] * len(c.order_keys))
+        c._local = t
+        return t, dp.wire_row_bytes, dp.codec_str
+
+    def _replicate_local(self, t: Table, m) -> ShardedRel:
+        self._reset_edge(m)
+        live = t.num_rows
+        logical = live * transport.logical_row_bytes(t.columns)
+        copies = self.n_peers - 1
+        rep = self.rep_spec
+
+        def put(a):
+            return jax.device_put(a, rep)
+
+        if self.pack:
+            # host-materialized payload: the dynamic-size codecs
+            # (dict/rle) apply here, and the decode runs on the lifted
+            # (replicated) planes — unpack on the receiving shard
+            hp = transport.pack_host(list(t.columns), list(t.names),
+                                     self.codecs)
+            cols = transport.unpack_host_device(hp, put)
+            self._edge(m, "broadcast", logical, hp.wire_bytes,
+                       hp.codec_str, copies=copies)
+        else:
+            cols = []
+            for c in t.columns:
+                validity = c.validity
+                if validity is not None:
+                    validity = put(validity)
+                cols.append(dataclasses.replace(c, data=put(c.data),
+                                                validity=validity))
+            self._edge(m, "broadcast", logical, logical, "",
+                       copies=copies)
+        valid = put(jnp.ones((t.num_rows,), bool))
         return ShardedRel(Table(cols, names=list(t.names)), valid,
                           replicated=True)
 
     def _broadcast(self, c: ShardedRel, m) -> ShardedRel:
         if c.replicated:
             return c
-        arrays, layout = _pack_cols(c.table, list(c.table.names))
+        self._reset_edge(m)
+        names = list(c.table.names)
+        cols = list(c.table.columns)
+        live = c.num_rows
+        copies = self.n_peers - 1
+        logical = live * transport.logical_row_bytes(cols)
+        dp = layout = None
+        if self.pack:
+            dp = transport.pack_device(cols, names, c.valid, self.codecs)
+            arrays = dp.planes
+            wire = live * dp.wire_row_bytes
+            codec = dp.codec_str
+        else:
+            arrays, layout = _pack_cols(c.table, names)
+            wire, codec = logical, ""
         key = ("broadcast", self.mesh, self.axis, len(arrays) + 1)
         fn = _jitted(key, lambda: jax.jit(
             lambda *xs: xs, out_shardings=self.rep_spec))
         outs = fn(*arrays, c.valid)
-        cols = _unpack_cols(outs[:-1], layout)
-        m.exchange_how = "broadcast"
-        m.exchange_bytes = sum(a.nbytes for a in arrays) * self.n_peers
-        return ShardedRel(Table(cols, names=list(c.table.names)),
+        if dp is not None:
+            out_cols = transport.unpack_device(outs[:-1], dp)
+        else:
+            out_cols = _unpack_cols(outs[:-1], layout)
+        self._edge(m, "broadcast", logical, wire, codec, copies=copies)
+        return ShardedRel(Table(out_cols, names=names),
                           outs[-1].astype(jnp.bool_), replicated=True)
 
     def _repartition(self, node, c: ShardedRel, m) -> ShardedRel:
-        rel, nbytes = self._repartition_rel(node, c, list(node.keys), m,
-                                            "repart")
-        m.exchange_how = "hash"
-        m.exchange_bytes = nbytes
+        self._reset_edge(m)
+        rel, logical, wire, codec = self._repartition_rel(
+            node, c, list(node.keys), m, "repart")
+        self._edge(m, "hash", logical, wire, codec)
         return rel
 
     def _repartition_rel(self, node, c: ShardedRel, keys, m, tag: str):
-        """Hash-exchange a sharded relation by `keys`; returns the
-        repartitioned rel + the buffer bytes moved."""
+        """Hash-exchange a sharded relation by `keys`; returns
+        (repartitioned rel, logical payload bytes, wire bytes, codec
+        string). Key columns ride their 64-bit order-preserving word
+        encoding (8 B x total_words each — the hash input, never
+        narrowed); value columns ship packed."""
         from ..parallel.relational import distributed_repartition_keyed
         specs = _key_specs(c.table, keys)
         if specs is None or not table_shardable(c.table):
             raise NotImplementedError
         words = _encode_keys(c.table, keys, specs)
         vnames = [nm for nm in c.table.names if nm not in set(keys)]
-        vals, layout = _pack_cols(c.table, vnames)
-        nbytes = [0]
+        val_cols = [c.table[nm] for nm in vnames]
+        live = c.num_rows
+        key_word_bytes = 8 * sum(sp.total_words for sp in specs)
+        logical_row = key_word_bytes + transport.logical_row_bytes(val_cols)
+        dp = layout = None
+        if self.pack:
+            dp = transport.pack_device(val_cols, vnames, c.valid,
+                                       self.codecs)
+            vals = dp.planes
+            wire_row = key_word_bytes + dp.wire_row_bytes
+            codec = dp.codec_str
+        else:
+            vals, layout = _pack_cols(c.table, vnames)
+            wire_row, codec = logical_row, ""
 
         nw, nv = len(words), len(vals)
         # the cached jitted callables must close over LOCALS only: a
         # `self` capture would pin the executor (and its plan/LRU graph)
         # in the process-global cache long after the session ends
-        mesh, axis, n_peers = self.mesh, self.axis, self.n_peers
+        mesh, axis = self.mesh, self.axis
 
         def run(slack):
             key = ("repart", mesh, axis, tuple(specs), nw, nv, slack)
@@ -636,23 +923,21 @@ class DistContext:
                     mesh, list(arrs[:nw]), specs,
                     list(arrs[nw:-1]), slack=slack, axis=axis,
                     alive=arrs[-1])))
-            out = fn(*words, *vals, c.valid)
-            cap = max(1, math.ceil((c.padded_rows // n_peers)
-                                   / n_peers * slack))
-            nbytes[0] = self._exchange_bytes(list(words) + list(vals),
-                                             n_peers, cap)
-            return out
+            return fn(*words, *vals, c.valid)
 
         ws, vs, alive, _ = self._retry(
             node, tag, run, self._caps(node, tag, {"slack": self.slack}), m)
         alive = alive.astype(jnp.bool_)
         cols = dict(_decode_keys(ws, specs, keys, alive))
-        cols.update({nm: col for nm, col
-                     in zip(vnames, _unpack_cols(vs, layout))})
+        if dp is not None:
+            unpacked = transport.unpack_device(list(vs), dp)
+        else:
+            unpacked = _unpack_cols(vs, layout)
+        cols.update({nm: col for nm, col in zip(vnames, unpacked)})
         table = Table([cols[nm] for nm in c.table.names],
                       names=list(c.table.names))
-        return ShardedRel(table, alive,
-                          part=frozenset({tuple(keys)})), nbytes[0]
+        return (ShardedRel(table, alive, part=frozenset({tuple(keys)})),
+                live * logical_row, live * wire_row, codec)
 
     # -- joins ---------------------------------------------------------------
     def _dist_join(self, node, childs, m, metrics):
@@ -691,16 +976,17 @@ class DistContext:
         # else repartitions implicitly here (bytes on this node's metric)
         if not r.replicated and \
                 not join_aligned(l.part, r.part, lk, rk):
-            moved = 0
+            # a fault-retried attempt re-describes its implicit edges
+            self._reset_edge(m)
             if tuple(lk) not in l.part:
-                l, b = self._repartition_rel(node, l, lk, m, "repart_l")
-                moved += b
+                l, lg, lwb, lc = self._repartition_rel(node, l, lk, m,
+                                                       "repart_l")
+                self._edge(m, "hash", lg, lwb, lc)
                 l_moved = True
             if tuple(rk) not in r.part:
-                r, b = self._repartition_rel(node, r, rk, m, "repart_r")
-                moved += b
-            m.exchange_how = "hash"
-            m.exchange_bytes += moved
+                r, rg, rwb, rc = self._repartition_rel(node, r, rk, m,
+                                                       "repart_r")
+                self._edge(m, "hash", rg, rwb, rc)
         # the output's placement claim must name the tuples the rows are
         # ACTUALLY placed by — the aligned permutation, not the join-key
         # order (hash(b,a) placement claimed as (a,b) would let a
@@ -813,6 +1099,7 @@ class DistContext:
             self._default_cap(c.padded_rows)
         elide = (not fused_child) and part_satisfies(c.part, node.keys)
         nbytes = [0]
+        live_in = c.num_rows
 
         nw, nv = len(words), len(vals)
         mesh, axis, n_peers = self.mesh, self.axis, self.n_peers
@@ -834,10 +1121,13 @@ class DistContext:
                         mesh, list(arrs[:nw]), specs,
                         list(arrs[nw:-1]), list(agg_pairs),
                         key_cap=key_cap, axis=axis, alive=arrs[-1])))
-                # the all-to-all ships one int64 bucket set per key word
-                # and per agg partial
-                nbytes[0] = 8 * (nw + len(agg_pairs)) \
-                    * n_peers * n_peers * key_cap
+                # the all-to-all ships per-group PARTIALS, not rows: one
+                # int64 per key word and per agg partial, for at most
+                # min(live input rows, key_cap per shard) groups — the
+                # payload, counted once (bucket padding/slack excluded,
+                # like every other edge)
+                nbytes[0] = (8 * (nw + len(agg_pairs))
+                             * min(live_in, n_peers * key_cap))
             return fn(*words, *vals, c.valid)
 
         gws, outs, gvalid, _ = self._retry(
@@ -847,12 +1137,19 @@ class DistContext:
         if not elide:
             # the fused program's all-to-all ships per-group partials; the
             # bytes belong to the exchange BOUNDARY — the child Exchange
-            # node when the optimizer placed one, this node otherwise
+            # node when the optimizer placed one, this node otherwise.
+            # Partials are 64-bit exact accumulators: no packing applies,
+            # wire == logical on this edge
             tgt = m
             if fused_child and node.child.label in metrics:
                 tgt = metrics[node.child.label]
+            # re-describe on a fault-retried aggregate attempt (the
+            # fused Exchange's own execution deferred, so the child row
+            # carries only this attribution)
+            self._reset_edge(tgt)
             tgt.exchange_how = "hash"
-            tgt.exchange_bytes += nbytes[0]
+            tgt.exchange_bytes = nbytes[0]
+            tgt.exchange_bytes_logical = nbytes[0]
         from ..ops.aggregate import _agg_value_dtype
         cols = dict(_decode_keys(gws, specs, list(node.keys), gvalid))
         for (i, op), arr, (cn, o, out_name) in zip(agg_pairs, outs,
@@ -898,10 +1195,22 @@ class DistContext:
                 w = [~x for x in w]
             words.extend(w)
         vnames = [nm for nm in c.table.names if nm not in set(keys)]
-        vals, layout = _pack_cols(c.table, vnames)
+        val_cols = [c.table[nm] for nm in vnames]
+        live = c.num_rows
+        key_word_bytes = 8 * sum(sp.total_words for sp in specs)
+        logical_row = key_word_bytes + transport.logical_row_bytes(val_cols)
+        dp = layout = None
+        if self.pack:
+            dp = transport.pack_device(val_cols, vnames, c.valid,
+                                       self.codecs)
+            vals = dp.planes
+            wire_row = key_word_bytes + dp.wire_row_bytes
+            codec = dp.codec_str
+        else:
+            vals, layout = _pack_cols(c.table, vnames)
+            wire_row, codec = logical_row, ""
         nw, nv = len(words), len(vals)
-        mesh, axis, n_peers = self.mesh, self.axis, self.n_peers
-        nbytes = [0]
+        mesh, axis = self.mesh, self.axis
 
         def run(slack):
             key = ("sort", mesh, axis, tuple(specs),
@@ -910,19 +1219,17 @@ class DistContext:
                 lambda *arrs: distributed_sort_keyed(
                     mesh, list(arrs[:nw]), None, list(arrs[nw:-1]),
                     slack=slack, axis=axis, alive=arrs[-1])))
-            out = fn(*words, *vals, c.valid)
-            # bytes follow the slack that actually RAN (escalated on skew)
-            cap = max(1, math.ceil((c.padded_rows // n_peers)
-                                   / n_peers * slack))
-            nbytes[0] = self._exchange_bytes(words + vals, n_peers, cap)
-            return out
+            return fn(*words, *vals, c.valid)
 
         ws, vs, valid, _ = self._retry(
             node, "sort", run, self._caps(node, "sort",
                                           {"slack": self.slack}), m)
         valid = valid.astype(jnp.bool_)
-        m.exchange_how = "range"
-        m.exchange_bytes += nbytes[0]
+        # each live row crosses the range partition once; splitter
+        # samples/pool are metadata (uncounted, like bucket counts). A
+        # fault-retried attempt re-describes the edge, not accumulates
+        self._reset_edge(m)
+        self._edge(m, "range", live * logical_row, live * wire_row, codec)
         # un-invert descending words before decode
         i = 0
         dec_words = []
@@ -934,8 +1241,11 @@ class DistContext:
             i += sp.total_words
         cols = dict(_decode_keys(dec_words, specs, keys, valid))
         if nv:
-            cols.update({nm: col for nm, col
-                         in zip(vnames, _unpack_cols(list(vs), layout))})
+            if dp is not None:
+                unpacked = transport.unpack_device(list(vs), dp)
+            else:
+                unpacked = _unpack_cols(list(vs), layout)
+            cols.update({nm: col for nm, col in zip(vnames, unpacked)})
         table = Table([cols[nm] for nm in c.table.names],
                       names=list(c.table.names))
         if isinstance(node, TopK):

@@ -746,12 +746,29 @@ class PlanExecutor:
             # for this binding (analysis/footprint.py)
             cert = self._certify(opt, inputs, bound)
             cert_block = [cert.render()] if cert is not None else []
+            transport_block = ([self._transport_summary()]
+                               if self.mesh is not None
+                               and self.mode == "eager" else [])
             return "\n".join(["== authored ==", plan.explain(), "",
                               "== optimized ==", opt.explain(), "",
                               report.summary(), *cert_block,
+                              *transport_block,
                               self._kernel_summary()])
         from .optimizer import explain_optimized
         return explain_optimized(plan) + "\n" + self._kernel_summary()
+
+    @staticmethod
+    def _transport_summary() -> str:
+        """One exchange-transport line for a meshed explain(optimized=True)
+        (plan/transport.py, docs/distributed.md#transport): what the
+        exchanges of this plan would ship under the current knobs."""
+        from .. import config
+        pack = config.exchange_pack()
+        codecs = ",".join(sorted(config.exchange_codecs())) if pack else ""
+        return ("transport: pack=" + ("on" if pack else "off")
+                + f" codecs={codecs or 'none'}"
+                + " async=" + ("on" if config.exchange_async() else "off")
+                + " (wire vs logical bytes per edge on profile())")
 
     @staticmethod
     def _kernel_summary() -> str:
@@ -904,15 +921,26 @@ class PlanExecutor:
                     # retried to success: the fault was genuinely transient,
                     # so it must not count toward a later sticky trip
                     self.health.record_success(node.label)
-                if self.block_per_op:
-                    jax.block_until_ready([c.data for c in out.columns])
-                # wall is compute (all attempts), NOT the backoff idle time —
-                # that is reported separately in backoff_ms, not double-counted
-                m.wall_ms = (time.perf_counter() - t0) * 1e3 - m.backoff_ms
-                m.rows_in = sum(t.num_rows for t in child_tables)
-                m.rows_out = out.num_rows
-                m.bytes_out = operand_nbytes(
-                    out if isinstance(out, Table) else out.table)
+                if getattr(out, "pending", False):
+                    # async exchange in flight (plan/distributed.PendingRel,
+                    # SPARK_RAPIDS_TPU_EXCHANGE_ASYNC): blocking here would
+                    # forfeit the transfer/compute overlap — wall_ms,
+                    # rows_out, bytes_out, and overlap-ms stamp onto this
+                    # metric row when a consumer resolves it
+                    m.rows_in = sum(t.num_rows for t in child_tables)
+                else:
+                    if self.block_per_op:
+                        jax.block_until_ready([c.data
+                                               for c in out.columns])
+                    # wall is compute (all attempts), NOT the backoff idle
+                    # time — that is reported separately in backoff_ms,
+                    # not double-counted
+                    m.wall_ms = (time.perf_counter() - t0) * 1e3 \
+                        - m.backoff_ms
+                    m.rows_in = sum(t.num_rows for t in child_tables)
+                    m.rows_out = out.num_rows
+                    m.bytes_out = operand_nbytes(
+                        out if isinstance(out, Table) else out.table)
                 metrics[node.label] = m
                 results[id(node)] = out
         except BaseException as err:

@@ -49,19 +49,36 @@ class OperatorMetrics:
     # distributed-tier metrics (docs/distributed.md). `sharding` is the
     # operator's OUTPUT distribution ("rows@4" row-sharded over 4 peers,
     # "hash[k]@4" hash-partitioned by k, "replicated@4", "local" gathered
-    # to one device). `exchange_how`/`exchange_bytes` record data movement:
-    # the kind (hash/broadcast/gather, plus "range" for the sample-sort's
-    # splitter exchange inside Sort/TopK) and the ICI buffer bytes it
-    # moved — on Exchange nodes for planned boundaries, on the operator
-    # itself for implicit movement (an unplanned shuffle join's internal
-    # exchange, a Sort's range partition).
+    # to one device). `exchange_how` records the movement kind
+    # (hash/broadcast/gather, plus "range" for the sample-sort's splitter
+    # exchange inside Sort/TopK) — on Exchange nodes for planned
+    # boundaries, on the operator itself for implicit movement (an
+    # unplanned shuffle join's internal exchange, a Sort's range
+    # partition). Byte accounting is per edge, each edge counted ONCE
+    # (broadcast = payload x (n_peers-1)), live payload only — capacity
+    # padding, slack, and exchange metadata (masks, bucket counts) are
+    # excluded, matching the certifier's per-edge exchange model
+    # (analysis/footprint.py): `exchange_bytes` is the WIRE form (packed
+    # planes the edge actually ships; == logical with packing off) and
+    # `exchange_bytes_logical` the unpacked per-column payload the edge
+    # represents. `exchange_codecs` names the non-pass-through encodings
+    # chosen (plan/transport.py); `exchange_overlap_ms` is the transfer
+    # wall that ran concurrently with other plan work under async
+    # dispatch (SPARK_RAPIDS_TPU_EXCHANGE_ASYNC).
     sharding: str = ""
     exchange_how: str = ""
-    exchange_bytes: int = 0
+    exchange_bytes: int = 0            # bytes on the wire (packed form)
+    exchange_bytes_logical: int = 0    # unpacked payload bytes
+    exchange_codecs: str = ""
+    exchange_overlap_ms: float = 0.0
     n_peers: int = 0               # mesh size the operator ran over
 
     def to_dict(self) -> Dict:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        # both byte counters under explicit names: a JSONL consumer must
+        # never have to know that `exchange_bytes` means the wire form
+        d["exchange_bytes_wire"] = self.exchange_bytes
+        return d
 
 
 def render_profile(rows: List[OperatorMetrics],
@@ -141,7 +158,15 @@ def render_profile(rows: List[OperatorMetrics],
             if m.sharding:
                 parts.append(f"sharding {m.sharding}")
             if m.exchange_how:
-                parts.append(f"exchange {m.exchange_how} "
-                             f"{m.exchange_bytes} B moved")
+                ex = (f"exchange {m.exchange_how} "
+                      f"{m.exchange_bytes} B moved")
+                if m.exchange_bytes_logical and \
+                        m.exchange_bytes_logical != m.exchange_bytes:
+                    ex += f" ({m.exchange_bytes_logical} B logical)"
+                parts.append(ex)
+            if m.exchange_codecs:
+                parts.append(f"codecs {m.exchange_codecs}")
+            if m.exchange_overlap_ms:
+                parts.append(f"overlap {m.exchange_overlap_ms:.3f} ms")
             out.append(f"  dist: {', '.join(parts)}")
     return "\n".join(out)

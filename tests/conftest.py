@@ -128,6 +128,39 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.tpu_smoke)
 
 
+# ---------------------------------------------------------------------------
+# XLA memory-map pressure valve. XLA's CPU JIT mmap()s code pages for every
+# compiled executable and the kernel caps a process at vm.max_map_count
+# (~65530) mappings; the full suite compiles enough programs to reach
+# ~60k maps, and any growth then dies MID-RUN with a segfault inside
+# backend_compile — the crash lands on whichever test compiles next (the
+# timezone kernels, historically), not on a culprit. Shed compiled
+# programs when the count nears the cap: the persistent compilation
+# cache below makes the recompiles cheap, and executor-level caches
+# (fingerprint-keyed programs, caps memos) hold only PYTHON callables,
+# so their own hit accounting is unaffected.
+# ---------------------------------------------------------------------------
+_MAPS_HIGH_WATER = 45_000
+
+
+def _proc_map_count() -> int:
+    try:
+        with open(f"/proc/{os.getpid()}/maps", "rb") as f:
+            return sum(1 for _ in f)
+    except OSError:          # non-Linux: no map cap to manage
+        return 0
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _shed_xla_map_pressure():
+    yield
+    if _proc_map_count() > _MAPS_HIGH_WATER:
+        jax.clear_caches()
+
+
 # Persistent compilation cache: the suite jit-compiles hundreds of programs
 # (the distributed SPMD bodies take minutes); caching them across runs cuts
 # repeat suite time by an order of magnitude.

@@ -127,7 +127,9 @@ def test_exchange_payload_bounds_per_kind():
     inputs = {"t": _tbl(k=[1, 2, 3, 4], v=[1, 2, 3, 4])}
     by_id = certify_nodes(_toposort(hash_ex), n_peers=4, **_cert_kw(inputs))
     ex = by_id[id(hash_ex)]
-    assert ex.exchange_bytes_hi == 4 * 18     # each row moves at most once
+    # each row moves at most once, in WIRE form: the non-null int64 key
+    # rides one 8 B word, v at most its unpacked 9 B column width
+    assert ex.exchange_bytes_hi == 4 * (8 + 9)
     bcast = Exchange(scan, (), how="broadcast")
     by_id = certify_nodes(_toposort(bcast), n_peers=4, **_cert_kw(inputs))
     assert by_id[id(bcast)].exchange_bytes_hi == 4 * 18 * 3   # n-1 copies
@@ -138,7 +140,24 @@ def test_exchange_payload_bounds_per_kind():
     by_id = certify_nodes(_toposort(hash_ex), n_peers=1, **_cert_kw(inputs))
     assert by_id[id(hash_ex)].exchange_bytes_hi == 0
     assert certify(plan, n_peers=4,
-                   **_cert_kw(inputs)).exchange_bytes_hi == 4 * 18
+                   **_cert_kw(inputs)).exchange_bytes_hi == 4 * (8 + 9)
+
+
+def test_fused_aggregate_exchange_bounds_partials():
+    """A hash edge whose sole consumer is a keyed aggregate fuses into
+    the two-phase groupby at runtime and ships per-group int64 partials;
+    its bound is the larger of the row-payload and partial-payload
+    models (covering both runtime paths)."""
+    b = PlanBuilder()
+    plan = (b.scan("t", schema=["k", "v"]).exchange(keys=["k"])
+             .aggregate(["k"], [("v", "sum", "s"), ("v", "min", "lo"),
+                                ("v", "count", "c")]).build())
+    inputs = {"t": _tbl(k=[1, 1, 2, 2], v=[1, 2, 3, 4])}
+    cert = certify(plan, n_peers=4, **_cert_kw(inputs))
+    ex = next(bb for bb in cert.ops if bb.kind == "Exchange")
+    # row model: 8 (key word) + 9 (v); partial model: 8 x (1 word + 3
+    # aggs) = 32 — the partial model is wider and wins
+    assert ex.exchange_bytes_hi == 4 * 32
 
 
 def test_streaming_morsel_chain_bounds(tmp_path):

@@ -331,6 +331,211 @@ def test_stacked_consumers_never_elide_on_stale_placement():
         del os.environ["SPARK_RAPIDS_TPU_BROADCAST_ROWS"]
 
 
+# ---- exchange transport (plan/transport.py) ---------------------------------
+
+def _env(**kv):
+    """Scoped env override for one block (pytest's MonkeyPatch owns the
+    save/restore so this file never hand-rolls it)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def cm():
+        with pytest.MonkeyPatch.context() as mp:
+            for k, v in kv.items():
+                if v is None:
+                    mp.delenv(k, raising=False)
+                else:
+                    mp.setenv(k, v)
+            yield
+    return cm()
+
+
+def _det_tables(n=100):
+    """Deterministic tables for exact byte pins: all-match join keys."""
+    sales = Table([_icol(np.arange(n) % 40),
+                   _icol(np.arange(n) - 50)], names=["k", "v"])
+    dims = Table([_icol(np.arange(40)), _icol(np.arange(40) % 3)],
+                 names=["dk", "grp"])
+    return sales, dims
+
+
+def _join_plan():
+    b = PlanBuilder()
+    s = b.scan("sales", schema=["k", "v"])
+    d = b.scan("dims", schema=["dk", "grp"])
+    # (k, v) totally orders the rows, so distributed-vs-local parity is
+    # row-exact despite the join's emission-order caveat
+    return (s.join(d, left_on="k", right_on="dk")
+             .sort(["k", "v"]).build())
+
+
+def test_exchange_accounting_pinned_two_peer():
+    """The audit satellite's regression pin (pack OFF, so wire ==
+    logical): a hash edge counts each live row ONCE at key-word + value
+    width, broadcast counts payload x (n_peers - 1), the sink gather
+    collects the join output once — matching the certifier's per-edge
+    exchange model exactly."""
+    mesh = _mesh()
+    n = 100
+    sales, dims = _det_tables(n)
+    inputs = {"sales": sales, "dims": dims}
+    with _env(SPARK_RAPIDS_TPU_EXCHANGE_PACK="off",
+              SPARK_RAPIDS_TPU_BROADCAST_ROWS="1"):
+        res = _parity(_join_plan(), inputs, mesh)
+    ex = {m.label: m for m in res.metrics.values()
+          if m.kind == "Exchange" and m.exchange_how}
+    by_how = {}
+    for m in ex.values():
+        by_how.setdefault(m.exchange_how, []).append(m)
+    # shuffle edges: live x (8 B key word + 8 B int64 value), once each
+    hashes = sorted(m.exchange_bytes for m in by_how["hash"])
+    assert hashes == [40 * 16, n * 16]
+    # sink gather: join output (k, v, dk, grp — four non-null int64)
+    (g,) = by_how["gather"]
+    assert g.exchange_bytes == n * 32
+    assert all(m.exchange_bytes == m.exchange_bytes_logical
+               for m in ex.values())            # pack off: wire == logical
+    # broadcast counts payload x (n_peers - 1), not x n_peers
+    with _env(SPARK_RAPIDS_TPU_EXCHANGE_PACK="off"):
+        res = _parity(_join_plan(), inputs, mesh)
+    bc = next(m for m in res.metrics.values()
+              if m.exchange_how == "broadcast")
+    assert bc.exchange_bytes == 40 * 16 * (NDEV - 1)
+    assert bc.exchange_bytes == bc.exchange_bytes_logical
+
+
+def test_packed_exchanges_wire_under_logical_and_cert():
+    """Packing on (the default): parity holds, at least one edge
+    compresses (wire < logical), no edge's wire exceeds its logical, and
+    every planned edge's wire stays at or under the certifier's per-edge
+    payload bound (the `wire <= certified hi` inequality)."""
+    from spark_rapids_tpu.analysis.footprint import check_observed
+    mesh = _mesh()
+    sales, dims = _det_tables(200)
+    inputs = {"sales": sales, "dims": dims}
+    b = PlanBuilder()
+    s = b.scan("sales", schema=["k", "v"])
+    d = b.scan("dims", schema=["dk", "grp"])
+    plan = (s.join(d, left_on="k", right_on="dk")
+             .aggregate(["k"], [("v", "sum", "t")]).sort(["k"]).build())
+    with _env(SPARK_RAPIDS_TPU_BROADCAST_ROWS="1"):
+        res = _parity(plan, inputs, mesh)
+    edges = [m for m in res.metrics.values() if m.exchange_how]
+    assert edges and all(m.exchange_bytes <= m.exchange_bytes_logical
+                         for m in edges)
+    assert any(m.exchange_bytes < m.exchange_bytes_logical
+               for m in edges), "no edge compressed"
+    assert any(m.exchange_codecs for m in edges)
+    assert res.cert is not None
+    assert check_observed(res.cert, res) is None
+    # JSONL-facing dict carries both counters under explicit names
+    row = next(m.to_dict() for m in edges)
+    assert row["exchange_bytes_wire"] == row["exchange_bytes"]
+    assert "exchange_bytes_logical" in row
+    text = res.profile_text()
+    assert "B moved" in text and "B logical" in text
+
+
+def test_pack_off_and_codecs_none_restore_parity():
+    """The knob contract: pack off is byte-identical legacy accounting
+    (wire == logical everywhere); codecs=none keeps the packed layout but
+    chooses no per-column encodings."""
+    mesh = _mesh()
+    sales, dims = _det_tables(150)
+    inputs = {"sales": sales, "dims": dims}
+    plan = _join_plan()
+    ref = None
+    for env in ({"SPARK_RAPIDS_TPU_EXCHANGE_PACK": "off"},
+                {"SPARK_RAPIDS_TPU_EXCHANGE_CODECS": "none"},
+                {"SPARK_RAPIDS_TPU_EXCHANGE_CODECS": "for,bitpack"}):
+        with _env(**env):
+            res = _parity(plan, inputs, mesh)
+        out = res.table.to_pydict()
+        ref = ref or out
+        assert out == ref
+        if env.get("SPARK_RAPIDS_TPU_EXCHANGE_PACK") == "off" or \
+                env.get("SPARK_RAPIDS_TPU_EXCHANGE_CODECS") == "none":
+            assert all(m.exchange_bytes == m.exchange_bytes_logical
+                       for m in res.metrics.values() if m.exchange_how)
+
+
+def test_async_exchange_overlap_and_parity():
+    """SPARK_RAPIDS_TPU_EXCHANGE_ASYNC=on: the exchange's pack+transfer
+    runs on a worker thread (PendingRel) and the consumer resolves it —
+    bit-exact parity, and the deferred metric row (rows/bytes/wall +
+    overlap-ms) is stamped by resolve time."""
+    mesh = _mesh()
+    sales, dims = _tables(seed=41)
+    inputs = {"sales": sales, "dims": dims}
+    b = PlanBuilder()
+    s = b.scan("sales", schema=["k", "v"])
+    d = b.scan("dims", schema=["dk", "grp"])
+    plan = (s.join(d, left_on="k", right_on="dk")
+             .aggregate(["grp", "k"], [("v", "sum", "t")])
+             .sort(["k"]).build())
+    with _env(SPARK_RAPIDS_TPU_EXCHANGE_ASYNC="on",
+              SPARK_RAPIDS_TPU_BROADCAST_ROWS="1"):
+        res = _parity(plan, inputs, mesh)
+    hash_edges = [m for m in res.metrics.values()
+                  if m.kind == "Exchange" and m.exchange_how == "hash"]
+    assert hash_edges
+    for m in hash_edges:
+        assert m.rows_out > 0 and m.bytes_out > 0     # resolve stamped it
+        assert m.wall_ms is not None and m.wall_ms > 0
+        assert m.exchange_overlap_ms >= 0.0
+
+
+def test_gather_cache_hit_reports_zero_bytes():
+    """A DAG-shared gather: the first crossing carries (and charges) the
+    payload; a cache-served gather moves nothing and must report zero
+    bytes, or summed wire counters double-count the edge."""
+    from spark_rapids_tpu.plan.distributed import DistContext, shard_table
+    from spark_rapids_tpu.plan.metrics import OperatorMetrics
+    mesh = _mesh()
+    t = Table([_icol(np.arange(50)), _icol(np.arange(50) % 7)],
+              names=["a", "b"])
+    b = PlanBuilder()
+    plan = b.scan("t", schema=["a", "b"]).build()
+    ctx = DistContext(PlanExecutor(mesh=mesh), plan, {"t": t})
+    rel = shard_table(mesh, "data", t)
+    m1 = OperatorMetrics("e1", "Exchange")
+    m2 = OperatorMetrics("e2", "Exchange")
+    t1 = ctx._gather(rel, m1)
+    t2 = ctx._gather(rel, m2)
+    assert t1 is t2                       # served from the rel cache
+    assert t1.to_pydict() == t.to_pydict()
+    assert m1.exchange_bytes > 0
+    assert m2.exchange_how == "gather" and m2.exchange_bytes == 0
+    assert m2.exchange_bytes_logical == 0
+
+
+def test_nds_q72_distributed_parity_pack_on_and_off():
+    """NDS q72 through the distributed tier with packing forced on and
+    forced off: identical results both ways (and identical to the
+    single-device tier), with the packed run compressing at least one
+    edge. q5 runs in the nightly exchange gate
+    (benchmarks/exchange_bench.py) — one NDS plan keeps this inside the
+    tier-1 budget."""
+    from benchmarks.bench_nds_q72 import build_tables as bt72
+    from benchmarks.nds_plans import q72_inputs, q72_plan
+    mesh = _mesh()
+    inputs = q72_inputs(*bt72(4000, seed=5))
+    plan = q72_plan()
+    outs = {}
+    for mode in ("on", "off"):
+        with _env(SPARK_RAPIDS_TPU_EXCHANGE_PACK=mode):
+            res = _parity(plan, inputs, mesh)
+        outs[mode] = res.table.to_pydict()
+        edges = [m for m in res.metrics.values() if m.exchange_how]
+        if mode == "on":
+            assert any(m.exchange_bytes < m.exchange_bytes_logical
+                       for m in edges), "packing compressed no q72 edge"
+        else:
+            assert all(m.exchange_bytes == m.exchange_bytes_logical
+                       for m in edges)
+    assert outs["on"] == outs["off"]
+
+
 def test_capped_mesh_rejected_per_plan_names_operator():
     mesh = object()       # never touched: the check fires before any work
     ex = PlanExecutor(mode="capped", mesh=mesh)

@@ -1,0 +1,202 @@
+"""Exchange transport layer (plan/transport.py, docs/distributed.md
+#transport): pack/unpack round-trip parity over the dtype x validity
+matrix, codec selection vs strict pass-through, and the byte-accounting
+invariants (wire <= logical, pass-through == identical layout). The
+end-to-end distributed wiring is covered in tests/test_plan_distributed.py;
+this file pins the codec layer itself."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import spark_rapids_tpu  # noqa: F401
+from spark_rapids_tpu import dtypes
+from spark_rapids_tpu.columnar import Column
+from spark_rapids_tpu.plan import transport
+
+ALL = transport.ALL_CODECS
+
+_DTYPES = {
+    "i8": (dtypes.INT8, np.int8),
+    "i16": (dtypes.INT16, np.int16),
+    "i32": (dtypes.INT32, np.int32),
+    "i64": (dtypes.INT64, np.int64),
+    "f32": (dtypes.FLOAT32, np.float32),
+    "bool": (dtypes.BOOL, np.bool_),
+}
+
+
+def _col(tag, n, validity_mode, seed=0):
+    dt, np_dt = _DTYPES[tag]
+    rng = np.random.default_rng(seed)
+    if tag == "bool":
+        data = rng.integers(0, 2, n).astype(np_dt)
+    elif tag == "f32":
+        data = rng.standard_normal(n).astype(np_dt)
+    else:
+        info = np.iinfo(np_dt)
+        data = rng.integers(max(info.min, -1000),
+                            min(info.max, 1000), n).astype(np_dt)
+    if validity_mode == "none":
+        validity = None
+    elif validity_mode == "all_null":
+        validity = np.zeros(n, bool)
+    else:
+        validity = rng.integers(0, 2, n).astype(bool)
+    return Column(dtype=dt, length=n, data=jnp.asarray(data),
+                  validity=None if validity is None
+                  else jnp.asarray(validity))
+
+
+def _assert_col_roundtrip(src: Column, out: Column, live=None):
+    """Live valid slots must round-trip exactly; null/dead slot data is
+    sentinel garbage no consumer reads."""
+    assert out.dtype == src.dtype and out.length == src.length
+    mask = np.ones(src.length, bool) if live is None else np.asarray(live)
+    if src.validity is None:
+        assert out.validity is None or bool(np.asarray(out.validity)[mask].all())
+    else:
+        np.testing.assert_array_equal(np.asarray(out.validity)[mask],
+                                      np.asarray(src.validity)[mask])
+        mask = mask & np.asarray(src.validity)
+    np.testing.assert_array_equal(np.asarray(out.data)[mask],
+                                  np.asarray(src.data)[mask])
+
+
+@pytest.mark.parametrize("tag", sorted(_DTYPES))
+@pytest.mark.parametrize("validity_mode", ["none", "all_null", "mixed"])
+@pytest.mark.parametrize("n", [0, 1, 257])
+def test_device_pack_roundtrip_matrix(tag, validity_mode, n):
+    cols = [_col(tag, n, validity_mode, seed=n + 1),
+            _col("i64", n, "mixed", seed=7),
+            _col(tag, n, validity_mode, seed=n + 3)]
+    names = ["a", "b", "c"]
+    live = jnp.asarray(np.arange(n) % 3 != 0) if n else \
+        jnp.zeros((0,), bool)
+    dp = transport.pack_device(cols, names, live, ALL)
+    assert dp.wire_row_bytes <= dp.logical_row_bytes
+    out = transport.unpack_device(dp.planes, dp)
+    for src, dst in zip(cols, out):
+        _assert_col_roundtrip(src, dst, live=live)
+    # numpy mirror (the packed gather's decode) agrees
+    nps = transport.unpack_device_np([np.asarray(p) for p in dp.planes], dp)
+    for src, (data, validity) in zip(cols, nps):
+        dst = Column(dtype=src.dtype, length=n, data=jnp.asarray(data),
+                     validity=None if validity is None
+                     else jnp.asarray(validity))
+        _assert_col_roundtrip(src, dst, live=live)
+
+
+def test_device_for_narrowing_and_passthrough():
+    n = 512
+    narrow = Column(dtype=dtypes.INT64, length=n,
+                    data=jnp.asarray(np.arange(n, dtype=np.int64) % 200
+                                     + 10_000))
+    wide = Column(dtype=dtypes.INT64, length=n,
+                  data=jnp.asarray(
+                      np.linspace(-2**62, 2**62, n).astype(np.int64)))
+    live = jnp.ones((n,), bool)
+    dp = transport.pack_device([narrow, wide], ["nar", "wid"], live, ALL)
+    # narrow-range int64 -> uint8 FOR plane; full-range stays raw
+    assert "nar:for8" in dp.codec_str and "wid" not in dp.codec_str
+    assert dp.wire_row_bytes == 1 + 8
+    assert dp.logical_row_bytes == 8 + 8
+    out = transport.unpack_device(dp.planes, dp)
+    for src, dst in zip([narrow, wide], out):
+        _assert_col_roundtrip(src, dst)
+    # dead rows are excluded from the FOR range probe: a column whose
+    # LIVE prefix is narrow narrows even when dead slots carry garbage
+    mixed = Column(dtype=dtypes.INT64, length=n, data=jnp.asarray(
+        np.where(np.arange(n) < 8, np.arange(n), 2**62).astype(np.int64)))
+    live2 = jnp.asarray(np.arange(n) < 8)
+    dp2 = transport.pack_device([mixed], ["mix"], live2, ALL)
+    assert "mix:for8" in dp2.codec_str
+    (dec,) = transport.unpack_device(dp2.planes, dp2)
+    _assert_col_roundtrip(mixed, dec, live=live2)
+
+
+def test_device_validity_bitpack_collapses_planes():
+    n = 64
+    cols = [_col("i32", n, "mixed", seed=i) for i in range(5)]
+    names = [f"c{i}" for i in range(5)]
+    live = jnp.ones((n,), bool)
+    dp = transport.pack_device(cols, names, live, ALL)
+    assert "validity:bitpack" in dp.codec_str
+    # 5 bool planes (5 B/row) collapse into one bit-word plane (1 B/row)
+    assert dp.wire_row_bytes <= dp.logical_row_bytes - 4
+    for src, dst in zip(cols, transport.unpack_device(dp.planes, dp)):
+        _assert_col_roundtrip(src, dst)
+    # codecs "none": layout-only pass-through, wire == logical
+    dp_raw = transport.pack_device(cols, names, live, frozenset())
+    assert dp_raw.codec_str == ""
+    assert dp_raw.wire_row_bytes == dp_raw.logical_row_bytes
+
+
+@pytest.mark.parametrize("shape", ["sorted", "lowcard", "narrow", "wide",
+                                   "empty"])
+def test_host_codec_selection_and_roundtrip(shape):
+    n = 0 if shape == "empty" else 1000
+    rng = np.random.default_rng(11)
+    if shape == "sorted":
+        a = np.sort(rng.integers(0, 40, n)).astype(np.int64)
+        want = "rle"
+    elif shape == "lowcard":
+        a = rng.integers(0, 7, n).astype(np.int64) * 10**12
+        want = "dict8"
+    elif shape == "narrow":
+        a = rng.integers(0, 60_000, n).astype(np.int64)
+        want = "for16"
+    else:
+        a = rng.integers(-2**62, 2**62, n).astype(np.int64)
+        want = "raw"
+    validity = rng.integers(0, 2, n).astype(bool) if n else None
+    col = Column(dtype=dtypes.INT64, length=n, data=jnp.asarray(a),
+                 validity=None if validity is None
+                 else jnp.asarray(validity))
+    hp = transport.pack_host([col], ["x"], ALL)
+    got = dict(p.split(":") for p in hp.codec_str.split(",")
+               if p and ":" in p).get("x", "raw")
+    assert got == want, (shape, hp.codec_str)
+    assert hp.wire_bytes <= hp.logical_bytes
+    (out,) = transport.unpack_host(hp)
+    # host codecs are lossless for EVERY slot (null data included)
+    np.testing.assert_array_equal(np.asarray(out.data), a)
+    if validity is not None:
+        np.testing.assert_array_equal(np.asarray(out.validity), validity)
+    # device decode mirror (the broadcast receiving shard)
+    (dev,) = transport.unpack_host_device(hp, lambda x: x)
+    np.testing.assert_array_equal(np.asarray(dev.data), a)
+
+
+def test_host_float_and_bool_pass_through():
+    n = 100
+    rng = np.random.default_rng(3)
+    f = Column(dtype=dtypes.FLOAT64, length=n,
+               data=jnp.asarray(rng.standard_normal(n)))
+    bcol = Column(dtype=dtypes.BOOL, length=n,
+                  data=jnp.asarray(rng.integers(0, 2, n).astype(bool)))
+    hp = transport.pack_host([f, bcol], ["f", "b"], ALL)
+    assert "f:" not in hp.codec_str and "b:" not in hp.codec_str
+    outs = transport.unpack_host(hp)
+    np.testing.assert_array_equal(np.asarray(outs[0].data),
+                                  np.asarray(f.data))
+    np.testing.assert_array_equal(np.asarray(outs[1].data),
+                                  np.asarray(bcol.data))
+
+
+def test_bitmask_roundtrip():
+    for n in (0, 1, 7, 8, 9, 257):
+        mask = np.arange(n) % 5 != 0
+        plane, m = transport.pack_bits_device(jnp.asarray(mask))
+        assert m == n and np.asarray(plane).nbytes == (n + 7) // 8
+        np.testing.assert_array_equal(
+            transport.unpack_bits_np(np.asarray(plane), n), mask)
+
+
+def test_resolve_codecs_strict():
+    assert transport.resolve_codecs("auto") == ALL
+    assert transport.resolve_codecs("none") == frozenset()
+    assert transport.resolve_codecs("for,bitpack") == \
+        frozenset({"for", "bitpack"})
+    with pytest.raises(ValueError, match="unknown exchange codec"):
+        transport.resolve_codecs("zstd")

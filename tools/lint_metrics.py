@@ -17,6 +17,12 @@ Two AST rules over ``benchmarks/`` and ``bench.py``:
   (``common.registry_kernels``), or the literal ``"fallback"`` for a
   bench that never crosses the registry (bench.py's convention: stamping
   the registry summary would attribute kernels the run never ran).
+- ``missing-wire-bytes-stamp``: a call that stamps ``exchange_bytes=``
+  must also stamp ``exchange_bytes_wire=`` and
+  ``exchange_bytes_logical=`` (plan/transport.py split the legacy
+  counter into wire vs logical; a wire number silently compared against
+  a logical one is the same class of trajectory bug as a missing
+  backend stamp).
 - ``raw-jsonl-missing-stamp``: a ``json.dumps({...literal...})`` record
   must carry ``"backend"`` and ``"kernels"`` keys — unless it carries an
   ``"error"`` key (failure records describe infrastructure, not
@@ -78,6 +84,16 @@ def _lint_file(path: str, rel: str, findings: List[str]) -> None:
                     "(kernels_of(res) for plan benches, "
                     "registry_kernels(...) for registry-op benches, "
                     "\"fallback\" for registry-free ones)")
+            if "exchange_bytes" in kw and \
+                    not {"exchange_bytes_wire",
+                         "exchange_bytes_logical"} <= kw:
+                findings.append(
+                    f"{rel}:{node.lineno}: [missing-wire-bytes-stamp] "
+                    f"{name}() stamps exchange_bytes without "
+                    "exchange_bytes_wire/exchange_bytes_logical — a "
+                    "wire number silently compared against a logical "
+                    "one is not comparable (plan/transport.py, "
+                    "docs/distributed.md#transport)")
         elif name == "dumps" and node.args and \
                 isinstance(node.args[0], ast.Dict):
             keys = {k.value for k in node.args[0].keys
