@@ -20,6 +20,19 @@ nightly log shows how much chaos the engine actually absorbed. The soak
 FAILS (non-zero exit) on any parity miss, on zero injected faults, zero
 retries, or zero degraded completions — a silently-ineffective fault config
 must not pass as green.
+
+Multi-session serving soak (`--sessions N`, docs/serving.md): the same
+chaos config hammers N concurrent tenant sessions submitting a mixed
+q3/q5 workload through `serving.ServingScheduler` — the realistic
+mixed-workload load test the ROADMAP promised this harness would become.
+Asserts: bit-exact result parity against the fault-free solo run for
+EVERY session's EVERY completion (device, degraded, or cached), zero
+failed/starved sessions with a bounded p99 queue wait, >= 1
+parity-checked device-tier cache hit scheduler-wide after recovery
+(degraded results never cache, so most chaos-phase sessions legitimately
+finish hit-less), and the same injected-chaos effectiveness floor as the
+legacy mode. Emits one JSONL row per session with the serving stamps
+(`session`, `queue_wait_ms`, `cache_hit` — lint_metrics-enforced).
 """
 import os
 import sys
@@ -43,8 +56,132 @@ def _run(ex, plan, inputs):
     return res, (time.perf_counter() - t0) * 1e3
 
 
+def soak_serving(args):
+    """`--sessions N` mode: N tenants through the serving scheduler under
+    the seeded chaos config (module docstring, docs/serving.md)."""
+    from spark_rapids_tpu import faultinj
+    from spark_rapids_tpu.plan import PlanExecutor
+    from spark_rapids_tpu.runtime.health import DeviceHealthMonitor
+    from spark_rapids_tpu.serving import ServingScheduler
+    from benchmarks.bench_nds_q3 import build_tables as q3_tables
+    from benchmarks.bench_nds_q5 import build_tables as q5_tables
+    from benchmarks.nds_plans import (kernels_of, q3_inputs, q3_plan,
+                                      q5_inputs, q5_plan)
+
+    n_sessions = args.sessions
+    n = max(2000, int(30_000 * args.scale))
+    sales, dates3, items = q3_tables(n, seed=7)
+    tabs, dates5 = q5_tables(n, seed=3)
+    plans = {"q5": (q5_plan(), q5_inputs(tabs, dates5)),
+             "q3": (q3_plan(), q3_inputs(sales, dates3, items))}
+
+    solo = PlanExecutor(mode="eager")
+    refs = {q: solo.execute(p, i).table.to_pydict()
+            for q, (p, i) in plans.items()}
+
+    inj = faultinj.install(CONFIG)
+    health = DeviceHealthMonitor(cooldown_s=0)
+    ex = PlanExecutor(mode="eager", health=health)
+    plans_per_session = 3
+    p99_bound_ms = 60_000.0
+    try:
+        with ServingScheduler(ex, workers=3) as sched:
+            handles = [sched.open_session(
+                f"tenant-{i}",
+                priority=("interactive" if i % 2 == 0 else "batch"),
+                weight=1.0 + (i % 3),
+                # quota sized for the certifier's sound (cross-product
+                # loose) join bounds: quota REJECTION is a separate
+                # assertion surface (tests/test_serving.py), the soak
+                # measures fairness under admitted load
+                quota_bytes=1 << 50) for i in range(n_sessions)]
+            tickets = []
+            for i, h in enumerate(handles):
+                qs = ("q3", "q5", "q3") if i % 2 == 0 else \
+                    ("q5", "q3", "q5")
+                for q in qs[:plans_per_session]:
+                    plan, inputs = plans[q]
+                    tickets.append((h.id, q, h.submit(plan, inputs)))
+            per_session = {}
+            degraded = 0
+            for sid, q, tk in tickets:
+                res = tk.result(timeout=600)
+                if res.table.to_pydict() != refs[q]:
+                    raise SystemExit(
+                        f"serving soak: parity MISS for {sid}/{q} "
+                        f"(degraded={res.degraded}, cached={res.cached})")
+                degraded += int(res.degraded)
+                per_session.setdefault(sid, []).append(res)
+            faults = inj.get_and_reset_injected()
+            m = sched.metrics()
+            waits = []
+            for sid, s in m["sessions"].items():
+                if s["failed"] or s["completed"] != plans_per_session:
+                    raise SystemExit(f"serving soak: session {sid} "
+                                     f"starved or failed: {s}")
+                waits.append(s["queue_wait_ms"]["p99"])
+            p99 = max(waits)
+            if p99 > p99_bound_ms:
+                raise SystemExit(f"serving soak: p99 queue wait {p99:.0f} "
+                                 f"ms exceeds the {p99_bound_ms:.0f} ms "
+                                 "bound — a session starved")
+            if faults == 0 or degraded == 0:
+                raise SystemExit(f"serving soak ineffective: {faults} "
+                                 f"faults, {degraded} degraded — the "
+                                 "chaos config injected nothing worth "
+                                 "recovering from")
+            # recovery INSIDE the serving context (legacy stage 3): stop
+            # injecting, reset + half-open probe, then the device tier
+            # serves. FRESH inputs (new digest) force a cache MISS so
+            # this proves real device dispatch — a pre-fatal device-tier
+            # completion may sit in the cache, and a hit would pass this
+            # check without ever touching the recovered device
+            faultinj.uninstall()
+            health.reset_device()
+            s3, d3, i3 = q3_tables(max(512, n // 4), seed=77)
+            fresh = (q3_plan(), q3_inputs(s3, d3, i3))
+            fresh_ref = solo.execute(*fresh).table.to_pydict()
+            rec = handles[0].run(*fresh, timeout=600)
+            if rec.cached or rec.degraded or \
+                    rec.table.to_pydict() != fresh_ref:
+                raise SystemExit("serving soak: device tier failed to "
+                                 "recover after reset_device "
+                                 f"(degraded={rec.degraded}, "
+                                 f"cached={rec.cached})")
+            hot = handles[1].run(*fresh, timeout=600)
+            if not hot.cached or hot.degraded or \
+                    hot.table.to_pydict() != fresh_ref:
+                raise SystemExit("serving soak: the result cache served "
+                                 "no parity-checked device-tier hit "
+                                 f"after recovery (cached={hot.cached})")
+            m = sched.metrics()          # refresh: include recovery runs
+            cache_hits = m["cache"]["hits"]
+            for sid, s in sorted(m["sessions"].items()):
+                last = per_session[sid][-1]
+                emit_record(
+                    "chaos_soak_serving",
+                    {"sessions": n_sessions, "rows": n,
+                     "priority": s["priority"], "weight": s["weight"]},
+                    s["queue_wait_ms"]["mean"] or 1e-3, n,
+                    impl="serving_eager", session=sid,
+                    queue_wait_ms=s["queue_wait_ms"]["p99"],
+                    cache_hit=s["cache_hits"] > 0,
+                    kernels=kernels_of(last),
+                    retries=s["retries"], degraded=s["degraded"] > 0,
+                    faults_injected=faults,
+                    breaker=m["breaker"])
+    finally:
+        faultinj.uninstall()        # idempotent; recovery already uninstalled
+    print(f"serving soak OK: {n_sessions} sessions x {plans_per_session} "
+          f"plans, {faults} faults injected, {degraded} degraded, "
+          f"{cache_hits} cache hits served, p99 queue wait {p99:.1f} ms, "
+          "breaker recovered")
+
+
 def main(argv=None):
     args = parse_args(argv)
+    if args.sessions > 0:
+        return soak_serving(args)
     from spark_rapids_tpu import faultinj
     from spark_rapids_tpu.plan import PlanExecutor
     from spark_rapids_tpu.runtime.health import HALF_OPEN

@@ -30,6 +30,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--scale", type=float, default=1.0,
                     help="multiply all num_rows axes by this (e.g. 0.01 for smoke)")
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--sessions", type=int, default=0,
+                    help="multi-session serving soak width "
+                         "(benchmarks/chaos_soak.py: N concurrent tenant "
+                         "sessions through serving/scheduler.py; 0 keeps "
+                         "the legacy single-caller soak)")
     ap.add_argument("--cpu", action="store_true",
                     help="pin the CPU backend (CI smoke; the TPU tunnel can "
                          "hang at init — env-var pinning is unreliable under "
@@ -124,6 +129,9 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
                 kernels=None,
                 stats_hits: int = None,
                 adaptive: bool = None,
+                session: str = None,
+                queue_wait_ms: float = None,
+                cache_hit: bool = None,
                 **extra) -> Dict:
     """Build + print one bench JSONL record.
 
@@ -161,6 +169,16 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
     re-runs the plan survived), `faults_injected` (faultinj count drained
     via get_and_reset_injected), `degraded` (result produced by the CPU
     fallback tier after a breaker trip).
+
+    Optional serving fields (the multi-session soak and any bench that
+    measures through serving/scheduler.py — docs/serving.md): `session`
+    (the tenant session the measured result executed FOR), `queue_wait_ms`
+    (submit-to-dispatch wait the fair-share queue imposed), `cache_hit`
+    (served from the plan-result cache — a cached number measured no
+    execution at all and must never silently compare against a real
+    one, the same rule as the backend stamp). lint_metrics enforces that
+    a record stamping `queue_wait_ms` or `cache_hit` stamps `session`
+    too — a serving number without its tenant is not attributable.
 
     Optional optimizer fields (the plan-tier benches and the nightly
     optimizer-parity stage record these, see docs/optimizer.md):
@@ -206,6 +224,12 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
         rec["exchange_bytes_wire"] = exchange_bytes_wire
     if exchange_overlap_ms is not None:
         rec["exchange_overlap_ms"] = round(exchange_overlap_ms, 3)
+    if session is not None:
+        rec["session"] = session
+    if queue_wait_ms is not None:
+        rec["queue_wait_ms"] = round(queue_wait_ms, 3)
+    if cache_hit is not None:
+        rec["cache_hit"] = bool(cache_hit)
     if retries is not None:
         rec["retries"] = retries
     if faults_injected is not None:

@@ -23,6 +23,15 @@ python benchmarks/run_all.py --scale 0.01 --iters 5 --cpu
 # run, non-zero retry/degraded counts, and breaker recovery via
 # reset_device(); emits retries/faults_injected/degraded JSONL fields
 JAX_PLATFORMS=cpu python benchmarks/chaos_soak.py --scale 0.2 --cpu
+# multi-session serving soak (docs/serving.md): 8 concurrent tenant
+# sessions submit a mixed q3/q5 workload through serving.ServingScheduler
+# under the same seeded chaos config (transients + one fatal) — asserts
+# per-session bit-exact parity for every completion, zero failed/starved
+# sessions with a bounded p99 queue wait, >=1 parity-checked result-cache
+# hit, and breaker recovery after reset_device(); emits one JSONL row per
+# session with the session/queue_wait_ms/cache_hit stamps
+# (lint_metrics-enforced)
+JAX_PLATFORMS=cpu python benchmarks/chaos_soak.py --scale 0.2 --cpu --sessions 8
 # optimizer parity (docs/optimizer.md): the four NDS plans, capped tier,
 # optimizer off vs on — asserts result parity, nonzero pruned-column
 # counts on q5/q72, and a fingerprint-keyed jit-cache hit on a rebuilt

@@ -17,6 +17,9 @@ idiomatic JAX/XLA/Pallas/PJRT stack:
   HashJoin/HashAggregate/Exchange) over Table, validating builder, and an
   executor with eager / capped-jit / distributed tiers, per-operator
   metrics (explain/profile) and plan-granularity cap escalation.
+- `serving`: multi-tenant front door — fair-share session scheduler with
+  certified per-session memory quotas, bounded-queue backpressure,
+  breaker-aware degradation, and a fingerprint+digest plan-result cache.
 - `io`: native parquet footer parse/prune/filter + chunked page reader.
 - `interop`: Arrow C Data Interface export/import (JVM-facing surface).
 - `faultinj`: config-driven fault injection over the device-call surface.
@@ -38,7 +41,7 @@ __all__ = ["dtypes", "Column", "Table", "api", "__version__", "version_info"]
 
 
 _LAZY_SUBMODULES = ("api", "ops", "parallel", "io", "runtime", "interop",
-                    "columnar", "faultinj", "config", "plan")
+                    "columnar", "faultinj", "config", "plan", "serving")
 
 
 def __getattr__(name):

@@ -80,7 +80,7 @@ from .verifier import (PlanVerificationError, Violation, _propagate_schemas,
 
 __all__ = ["OpBound", "ResourceCert", "ResourceAdmissionError",
            "certify", "certify_nodes", "table_metadata",
-           "check_observed"]
+           "check_observed", "quota_charge"]
 
 _VALIDITY_BYTES = 1        # one bool plane byte per row per column
 _ACC_BYTES = 8             # aggregate accumulators widen to 64-bit
@@ -211,6 +211,39 @@ class ResourceCert:
     def __repr__(self):
         return (f"ResourceCert({len(self.ops)} ops, peak="
                 f"{self.peak_bytes_hi}, unbounded={len(self.unbounded)})")
+
+    def peak_op_label(self) -> str:
+        """Label of the operator that set `peak_bytes_hi` ("" when every
+        operator is unbounded) — the name an over-quota serving
+        diagnostic carries (docs/serving.md)."""
+        for b in self.ops:
+            if b.resident_bytes_hi is not None and \
+                    b.resident_bytes_hi == self.peak_bytes_hi:
+                return b.label
+        return ""
+
+
+def quota_charge(cert: Optional["ResourceCert"],
+                 default_bytes: int) -> Tuple[int, str, str]:
+    """Bytes one plan admission charges against a serving session's
+    memory quota (serving/scheduler.py, docs/serving.md).
+
+    The certified `peak_bytes_hi` is the charge when the certifier
+    bounded the plan — it is SOUND (the plan provably stays inside that
+    many resident bytes), so quota accounting inherits the same
+    no-guessing contract as the admission gate. A plan the certifier
+    could not bound (strings/nested columns, unbound scans, an internal
+    certifier decline) charges the flat `default_bytes` instead
+    (`SPARK_RAPIDS_TPU_SERVING_DEFAULT_CHARGE_BYTES`): unbounded plans
+    neither ride the quota for free nor get rejected outright.
+
+    Returns ``(bytes, source, op_label)``: source is ``"certified"`` or
+    ``"default"``; op_label names the operator that set the certified
+    peak ("" under the default) — the label an over-quota diagnostic
+    should carry."""
+    if cert is None or cert.peak_bytes_hi is None:
+        return int(default_bytes), "default", ""
+    return int(cert.peak_bytes_hi), "certified", cert.peak_op_label()
 
 
 # ---- the abstract interpreter ----------------------------------------------

@@ -38,6 +38,16 @@ equivalents, all read at use time (not import time) so tests can monkeypatch:
 | SPARK_RAPIDS_TPU_STATS           | on   | per-fingerprint operator-stats store (plan/stats.py, docs/adaptive.md): observed cardinalities drive join build sides / exchange modes, cap seeding, chunk sizing, and kernel tie-breaks; "off" restores fully static decisions |
 | SPARK_RAPIDS_TPU_STATS_CAPACITY  | 256  | stats store LRU bound: per-(backend, fingerprint) plan entries retained (subtree/kernel tables scale off this) |
 | SPARK_RAPIDS_TPU_STATS_PATH      | —    | optional JSONL persistence path for the stats store: records append per successful execution and load at first use, so observed stats survive the process |
+| SPARK_RAPIDS_TPU_SERVING_WORKERS | 2    | serving layer (serving/scheduler.py, docs/serving.md): dispatcher worker threads — the device-side execution concurrency |
+| SPARK_RAPIDS_TPU_SERVING_QUEUE_DEPTH | 64 | bounded admission queue: total plans queued across all sessions before submit blocks (or fast-rejects) |
+| SPARK_RAPIDS_TPU_SERVING_QUOTA_BYTES | 256 MiB | default per-session device-memory quota the dispatcher admits certified footprints against (per-session override at open_session) |
+| SPARK_RAPIDS_TPU_SERVING_DEFAULT_CHARGE_BYTES | 64 MiB | quota charge for plans the certifier could not bound (strings/unbound scans — footprint.quota_charge) |
+| SPARK_RAPIDS_TPU_SERVING_STARVATION_MS | 2000 | fair-share aging bound: a queued plan waiting longer than this dispatches next regardless of lane/deficit — no session starves |
+| SPARK_RAPIDS_TPU_SERVING_CACHE_ENTRIES | 64 | plan-result cache LRU bound (serving/cache.py); 0 disables the cache |
+| SPARK_RAPIDS_TPU_SERVING_CACHE_BYTES | 256 MiB | plan-result cache RESIDENT-BYTES bound: cached result tables are live buffers no quota charges, so the cache evicts LRU past this and refuses any single result larger than it |
+| SPARK_RAPIDS_TPU_SERVING_CACHE_TTL_S | 300 | plan-result cache entry time-to-live (seconds) |
+| SPARK_RAPIDS_TPU_SERVING_OVER_QUOTA | reject | what a plan whose quota charge exceeds the session's remaining quota ceiling does: reject (typed ServingRejectedError naming session + operator, before compilation) / degrade (run on the CPU tier — the device quota does not bind there) |
+| SPARK_RAPIDS_TPU_SERVING_BACKPRESSURE | block | submit() behavior at a full queue: block (wait for space) / reject (fast ServingRejectedError); per-submit override wins |
 
 The SPARK_RAPIDS_TPU_BREAKER_* numeric knobs are snapshotted when a
 `DeviceHealthMonitor` is constructed (one policy per monitor lifetime —
@@ -330,6 +340,103 @@ def stats_path() -> str:
     survive restarts. Empty string (default) keeps the store
     in-memory-only. Snapshotted when a StatsStore is constructed."""
     return os.environ.get("SPARK_RAPIDS_TPU_STATS_PATH", "")
+
+
+def serving_workers() -> int:
+    """Serving dispatcher worker threads (serving/scheduler.py,
+    docs/serving.md): how many admitted plans execute concurrently.
+    Small by design — workers contend for one device; the queue, not the
+    worker pool, absorbs traffic."""
+    return max(1, _int_env("SPARK_RAPIDS_TPU_SERVING_WORKERS", 2))
+
+
+def serving_queue_depth() -> int:
+    """Bounded serving queue: total queued (not yet dispatched) plans
+    across every session before submit() exerts backpressure. The bound
+    is the backpressure signal — an unbounded queue hides overload until
+    memory does the rejecting (StreamBox-HBM's bounded-pipeline
+    discipline, PAPERS.md)."""
+    return max(1, _int_env("SPARK_RAPIDS_TPU_SERVING_QUEUE_DEPTH", 64))
+
+
+def serving_quota_bytes() -> int:
+    """Default per-session device-memory quota (serving/scheduler.py):
+    the sum of a session's in-flight certified charges
+    (footprint.quota_charge) may not exceed this. Per-session override
+    at `open_session(quota_bytes=...)`."""
+    return max(1, _int_env("SPARK_RAPIDS_TPU_SERVING_QUOTA_BYTES",
+                           256 << 20))
+
+
+def serving_default_charge_bytes() -> int:
+    """Quota charge for a plan the certifier could not bound (strings,
+    unbound scans — footprint.quota_charge): a flat configurable amount,
+    so unbounded plans neither ride the quota for free nor get rejected
+    outright."""
+    return max(1, _int_env(
+        "SPARK_RAPIDS_TPU_SERVING_DEFAULT_CHARGE_BYTES", 64 << 20))
+
+
+def serving_starvation_ms() -> float:
+    """Fair-share aging bound (the starvation bound): a queued plan
+    waiting longer than this dispatches next, regardless of priority
+    lane or deficit state — weighted fairness may skew throughput but
+    must never unbound any session's queue wait."""
+    return max(0.0, _float_env("SPARK_RAPIDS_TPU_SERVING_STARVATION_MS",
+                               2000.0))
+
+
+def serving_cache_entries() -> int:
+    """Plan-result cache LRU bound (serving/cache.py): completed results
+    retained per scheduler, keyed by canonical plan fingerprint +
+    input-data digest. 0 disables the cache entirely."""
+    return max(0, _int_env("SPARK_RAPIDS_TPU_SERVING_CACHE_ENTRIES", 64))
+
+
+def serving_cache_bytes() -> int:
+    """Plan-result cache resident-bytes bound (serving/cache.py): cached
+    tables are live device/host buffers that NO session quota charges
+    (the quota covers in-flight execution, not retention), so the cache
+    itself must bound what it pins — LRU eviction past this total, and a
+    single result larger than it never caches at all (a one-entry cache
+    that thrashes the whole budget serves nobody)."""
+    return max(1, _int_env("SPARK_RAPIDS_TPU_SERVING_CACHE_BYTES",
+                           256 << 20))
+
+
+def serving_cache_ttl_s() -> float:
+    """Plan-result cache time-to-live: entries older than this never
+    serve (and evict on the next touch). <=0 means no TTL (LRU only)."""
+    return _float_env("SPARK_RAPIDS_TPU_SERVING_CACHE_TTL_S", 300.0)
+
+
+def serving_over_quota() -> str:
+    """Policy when a plan's quota charge exceeds its session's quota
+    ceiling: "reject" raises a typed ServingRejectedError naming the
+    session and the operator that set the certified peak, BEFORE any
+    compilation; "degrade" runs the plan on the CPU tier, where the
+    device quota does not bind. Same strict-typo policy as the kernel
+    selectors."""
+    v = os.environ.get("SPARK_RAPIDS_TPU_SERVING_OVER_QUOTA", "reject")
+    if v not in ("reject", "degrade"):
+        raise ValueError(
+            f"SPARK_RAPIDS_TPU_SERVING_OVER_QUOTA={v!r}: expected reject "
+            "or degrade")
+    return v
+
+
+def serving_backpressure() -> str:
+    """submit() behavior at a full queue: "block" waits for space (the
+    synchronous-caller posture), "reject" raises ServingRejectedError
+    immediately (the load-shedding posture). The per-submit `block=`
+    argument overrides. Same strict-typo policy as the kernel
+    selectors."""
+    v = os.environ.get("SPARK_RAPIDS_TPU_SERVING_BACKPRESSURE", "block")
+    if v not in ("block", "reject"):
+        raise ValueError(
+            f"SPARK_RAPIDS_TPU_SERVING_BACKPRESSURE={v!r}: expected block "
+            "or reject")
+    return v
 
 
 def faultinj_config_path() -> str:

@@ -137,7 +137,8 @@ class FaultInjector:
 
     def reset_device(self) -> None:
         """Clear the poisoned-device state (executor-restart analogue)."""
-        self._device_poisoned = False
+        with self._lock:
+            self._device_poisoned = False
 
     @property
     def device_poisoned(self) -> bool:
@@ -168,8 +169,13 @@ class FaultInjector:
         log.debug("injecting fault type %d into %s", rule.injection_type, api_name)
         with self._lock:
             self._injected += 1
+            if rule.injection_type == FAULT_FATAL:
+                # poison INSIDE the lock: under concurrent sessions a racing
+                # reset_device() must observe either the un-poisoned or the
+                # fully-poisoned state, never a torn interleaving where the
+                # fatal was counted but the device stayed healthy
+                self._device_poisoned = True
         if rule.injection_type == FAULT_FATAL:
-            self._device_poisoned = True
             raise DeviceFatalError(f"injected fatal device fault in {api_name}")
         if rule.injection_type == FAULT_ASSERT:
             raise DeviceAssertError(f"injected device assert in {api_name}")

@@ -96,11 +96,11 @@ _DIST_AGGS = ("sum", "count", "min", "max", "size")
 # jitted distributed primitives, keyed by (name, mesh, axis, static params):
 # an eager shard_map re-traces AND re-compiles per call; one bounded cache
 # for the whole process keeps repeat executions at dispatch cost.
-# LruDict's get (check-then-pop) and eviction loop are not thread-safe and
-# async exchange workers (PendingRel) hit this cache concurrently — one
-# lock covers every shared-memo access on the distributed path
+# LruDict.get/__setitem__ are internally locked (utils/lru.py — the
+# serving layer made every shared memo self-guarding), so the async
+# exchange workers (PendingRel) that hit this cache concurrently need no
+# external lock for single get/insert operations
 _JIT_PRIMS = LruDict(256)
-_MEMO_LOCK = threading.Lock()
 
 
 def _jitted(key, builder):
@@ -108,12 +108,10 @@ def _jitted(key, builder):
     the final (already jit-wrapped) function. Safe under concurrent async
     exchange workers: a lost race builds one redundant (cheap, un-traced)
     wrapper, never corrupts the cache."""
-    with _MEMO_LOCK:
-        fn = _JIT_PRIMS.get(key)
+    fn = _JIT_PRIMS.get(key)
     if fn is None:
         fn = builder()
-        with _MEMO_LOCK:
-            _JIT_PRIMS[key] = fn
+        _JIT_PRIMS[key] = fn
     return fn
 
 
@@ -563,8 +561,7 @@ class DistContext:
         return (self.plan.fingerprint, self._node_index[id(node)], tag)
 
     def _caps(self, node, tag: str, defaults: Dict) -> Dict:
-        with _MEMO_LOCK:
-            memo = self.ex._dist_caps_memo.get(self._memo_key(node, tag))
+        memo = self.ex._dist_caps_memo.get(self._memo_key(node, tag))
         caps = dict(defaults)
         for k, v in (memo or {}).items():
             if k in caps:
@@ -583,9 +580,8 @@ class DistContext:
                                          self.ex.max_cap_attempts)
         if m is not None:
             m.escalations += attempts[0] - 1
-        with _MEMO_LOCK:
-            self.ex._dist_caps_memo[self._memo_key(node, tag)] = \
-                dict(final)
+        self.ex._dist_caps_memo[self._memo_key(node, tag)] = \
+            dict(final)
         return out
 
     # -- helpers -------------------------------------------------------------

@@ -112,9 +112,27 @@ def _ops():
     return ops
 
 
+def _sessionctx():
+    from ..runtime import sessionctx
+    return sessionctx
+
+
 # one bounded-cache definition for the whole engine (utils/lru.py): the
 # executor's program/caps memos and the optimizer cache share it
 from ..utils.lru import LruDict as _LruDict
+
+
+def bind_scan_sources(plan: Plan, inputs: Optional[Dict]) -> Dict:
+    """The ONE scan-binding prologue: a Scan carrying its own parquet
+    binding needs no inputs= entry; an explicit entry (Table or source)
+    for the same name wins. Shared by execute() and the serving layer's
+    submit path (serving/scheduler.py) — the binding the cache digest and
+    quota charge are computed from must be the binding that executes."""
+    inputs = dict(inputs or {})
+    for s in plan.scans:
+        if s.source not in inputs and s.parquet is not None:
+            inputs[s.source] = s.parquet
+    return inputs
 
 
 def _cpu_device():
@@ -354,6 +372,16 @@ class PlanResult:
         self.cert = None              # analysis/footprint.ResourceCert for
         #                               the executed plan (set by execute();
         #                               None when the certifier declined)
+        self.session = ""             # serving-session stamp (docs/serving
+        #                               .md): set by execute() from the
+        #                               active sessionctx scope, "" outside
+        #                               the serving layer
+        self.cached = False           # served from the serving result cache
+        #                               (serving/cache.py): True ONLY on a
+        #                               cache-hit COPY — its metrics are
+        #                               deep copies, so profile/bench
+        #                               consumers never double-attribute
+        #                               the original run's wall time
 
     def compact(self) -> Table:
         """Live rows only (identity in the eager tier)."""
@@ -472,14 +500,19 @@ class PlanExecutor:
 
     # ---- entry point ------------------------------------------------------
     def execute(self, plan: Plan,
-                inputs: Optional[Dict[str, Table]] = None) -> PlanResult:
+                inputs: Optional[Dict[str, Table]] = None,
+                tier: Optional[str] = None) -> PlanResult:
+        """Run `plan` over `inputs`. `tier` pins the execution tier:
+        None/"device" is the normal path (device with breaker-gated CPU
+        degradation); "cpu" runs the WHOLE plan on the degraded CPU tier
+        without touching the device — the serving layer's route for
+        over-quota admission under the degrade policy and for draining a
+        queue while the breaker is open (docs/serving.md)."""
+        if tier not in (None, "device", "cpu"):
+            raise ValueError(f"unknown execution tier {tier!r} "
+                             "(expected device or cpu)")
         self._check_capped_mesh(plan)
-        # a Scan carrying its own parquet binding needs no inputs= entry;
-        # an explicit entry (Table or source) for the same name wins
-        inputs = dict(inputs or {})
-        for s in plan.scans:
-            if s.source not in inputs and s.parquet is not None:
-                inputs[s.source] = s.parquet
+        inputs = bind_scan_sources(plan, inputs)
         missing = [s for s in plan.input_names if s not in inputs]
         if missing:
             raise PlanValidationError(f"unbound plan input(s) {missing}")
@@ -509,9 +542,17 @@ class PlanExecutor:
         # BEFORE any compilation when one is configured
         cert = self._certify(plan, inputs, bound)
         res = None
+        if tier == "cpu":
+            # pinned to the degraded tier: same machinery as a breaker
+            # trip, without consulting the device budget (it does not
+            # bind on the CPU tier)
+            self.health.start_plan_attempt()
+            res = self._execute_degraded(
+                plan, inputs, schemas, {}, {}, start=0,
+                t_plan0=time.perf_counter(), mode=self.mode)
         budget = (self.cert_budget if self.cert_budget is not None
                   else config.cert_budget_bytes())
-        if budget and cert is not None:
+        if res is None and budget and cert is not None:
             violations = cert.over_budget(budget)
             if violations:
                 from ..analysis.footprint import ResourceAdmissionError
@@ -536,6 +577,15 @@ class PlanExecutor:
                 res = self._execute(plan, inputs, schemas, source_fp,
                                     cert)
         res.cert = cert
+        # serving-session stamp (runtime/sessionctx.py, docs/serving.md):
+        # results and per-op metrics carry the tenant they executed for —
+        # dispatcher worker threads are multiplexed across sessions, so
+        # thread identity cannot answer this after the fact
+        sid = _sessionctx().current_session_id()
+        if sid is not None:
+            res.session = sid
+            for mm in res.metrics.values():
+                mm.session = sid
         if report is not None:
             res.optimizer = report.to_dict()
         from . import stats as stats_mod

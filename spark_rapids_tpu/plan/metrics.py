@@ -31,6 +31,11 @@ class OperatorMetrics:
     escalations: int = 0       # cap-growth retries charged to this node
     backoff_ms: float = 0.0    # time spent backing off before retries
     degraded: bool = False     # ran on the degraded CPU tier (breaker open)
+    # serving-session stamp (serving/scheduler.py, docs/serving.md): the
+    # tenant session this operator executed for, "" outside the serving
+    # layer — per-tenant accounting must never be inferred from thread
+    # identity (dispatcher workers are multiplexed across sessions)
+    session: str = ""
     # kernel-registry choice for operators with registered alternatives
     # (ops/registry.py, docs/kernels.md): "pallas:fused_select",
     # "scan:groupby", "xla:topk", ... — trajectory numbers must never

@@ -128,6 +128,12 @@ class StatsStore:
         self.kernel_epoch = 0
         self.hits = 0
         self._lock = threading.RLock()
+        # persistence appends serialize separately from the table lock:
+        # two sessions recording concurrently must not interleave half a
+        # JSONL line each (replay tolerates torn lines, but silently
+        # dropping both records is not "best-effort", it is data loss),
+        # and file IO must not extend the hot lock's hold time
+        self._io_lock = threading.Lock()
         if self.path:
             self._load(self.path)
 
@@ -340,7 +346,7 @@ class StatsStore:
 
     def _append(self, event: Dict) -> None:
         try:
-            with open(self.path, "a", encoding="utf-8") as f:
+            with self._io_lock, open(self.path, "a", encoding="utf-8") as f:
                 f.write(json.dumps(event) + "\n")
         except OSError:
             pass                # persistence is best-effort observability

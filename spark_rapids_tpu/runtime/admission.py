@@ -18,6 +18,15 @@ threads wake, mirroring `do_deallocate` (SparkResourceAdaptorJni.cpp:1756).
 With no active session every wrapper is a zero-cost pass-through, so the
 engine runs unbudgeted by default (the reference likewise only arbitrates
 once RmmSpark.setEventHandler installs the adaptor).
+
+Two session notions compose here (docs/serving.md): a `DeviceSession` is
+a MEMORY BUDGET (this module's thread-scoped `active_session`), while a
+serving-tenant session is an ACCOUNTING IDENTITY
+(`runtime/sessionctx.py`, installed by the serving dispatcher around
+every job). Health budgets/sticky windows key on the tenant identity —
+per-session, thread fallback — so a DeviceSession shared by all serving
+workers still arbitrates one device budget while failure isolation stays
+per tenant.
 """
 from __future__ import annotations
 

@@ -35,6 +35,19 @@ Health metrics drain with get-and-reset semantics like the arbiter's
 (`ResourceArbiter.get_and_reset_num_retry_throw`): `get_and_reset_metrics()`
 returns the counters accumulated since the previous call and zeroes them.
 
+Multi-tenant keying (runtime/sessionctx.py, docs/serving.md): the
+SESSION the work belongs to — the explicit id installed by
+`sessionctx.session_scope` (the serving dispatcher wraps every job in
+one), falling back to thread identity when unscoped — keys the failure
+state. Thread keying alone aliased tenants the moment the serving layer
+multiplexed sessions over worker threads: one pathological tenant's
+failures would drain the budget — or arm the sticky window — of whoever
+landed on that thread next. Sticky windows key per (session, op);
+retry budgets per (session, thread), so one tenant's concurrent plans
+on different workers stay independently bounded per plan attempt. The
+breaker itself stays DEVICE-scoped: a fatal fault poisons the device
+for every session, whoever triggered it.
+
 Co-processing precedent: treating the CPU as a second execution tier is
 how coupled CPU-GPU systems keep serving under device loss ("Revisiting
 Co-Processing for Hash Joins on the Coupled CPU-GPU Architecture",
@@ -193,11 +206,23 @@ class DeviceHealthMonitor:
         self._clock = clock
         self._rng = rng or random.Random()
         self._lock = threading.Lock()
-        # retry budget is per plan attempt AND per thread: concurrent
-        # executors sharing one monitor must not refill (or starve) each
-        # other's bound mid-plan
-        self._local = threading.local()
-        self._failures: Dict[str, Deque[float]] = {}
+        # retry budget is per plan attempt, keyed by (session, thread)
+        # (sessionctx.session_key x executing thread): the session
+        # component stops two tenants multiplexed over one serving worker
+        # thread from sharing one bound, while the thread component keeps
+        # ONE tenant's concurrent plans on different workers independently
+        # bounded — a same-tenant neighbour's start_plan_attempt() must
+        # not refill (or its retries starve) this plan's budget mid-plan.
+        # Bounded: dead sessions' residue must not grow the monitor
+        # forever. The bound errs on the soft side — an evicted live
+        # entry refills on the next try_retry — so it sits far above any
+        # plausible in-flight count: keys are created only by
+        # start_plan_attempt/try_retry, one per concurrently executing
+        # plan per thread, and 8192 distinct keys would have to churn
+        # through DURING one plan's backoff sleep to soften its bound.
+        from ..utils.lru import LruDict
+        self._budgets: Dict[tuple, int] = LruDict(8192)
+        self._failures: Dict[tuple, Deque[float]] = {}
         self._reset_hooks: List[Callable[[], None]] = []
         self._metrics: Dict[str, float] = collections.defaultdict(float)
 
@@ -206,17 +231,28 @@ class DeviceHealthMonitor:
     def record_failure(self, op: str, exc: BaseException) -> str:
         """Record one failure of `op` and classify it. Fatal faults classify
         immediately; otherwise stickiness is N failures of the SAME op
-        within the window (old entries age out)."""
+        UNDER THE SAME SESSION within the window (old entries age out) —
+        tenant A's flaky operator must not arm a sticky trip against
+        tenant B's first failure of the same op."""
         from .. import faultinj
+        from . import sessionctx
         now = self._clock()
         with self._lock:
             if isinstance(exc, faultinj.DeviceFatalError):
                 self._metrics["fatal_faults"] += 1
                 return FATAL
-            dq = self._failures.setdefault(op, collections.deque())
+            dq = self._failures.setdefault((sessionctx.session_key(), op),
+                                           collections.deque())
             dq.append(now)
             while dq and now - dq[0] > self.sticky_window_s:
                 dq.popleft()
+            if len(self._failures) > 4096:
+                # dead-session residue: windows whose every entry has aged
+                # out carry no sticky evidence — drop them instead of
+                # growing per (session, op) forever
+                self._failures = {
+                    k: d for k, d in self._failures.items()
+                    if d and now - d[-1] <= self.sticky_window_s}
             if len(dq) >= self.sticky_threshold:
                 self._metrics["sticky_faults"] += 1
                 return STICKY
@@ -225,34 +261,45 @@ class DeviceHealthMonitor:
 
     def record_success(self, op: str) -> None:
         """A unit that eventually SUCCEEDED proves its faults were not
-        sticky: clear the op's failure window so occasional absorbed
-        transients (one per job, say) never accumulate across executions
-        into a quarantine of a device that recovers every time. Sticky
-        therefore means: repeated failures with no intervening success."""
+        sticky: clear the op's failure window (for the session that ran
+        it) so occasional absorbed transients (one per job, say) never
+        accumulate across executions into a quarantine of a device that
+        recovers every time. Sticky therefore means: repeated failures
+        with no intervening success."""
+        from . import sessionctx
         with self._lock:
-            dq = self._failures.get(op)
+            dq = self._failures.get((sessionctx.session_key(), op))
             if dq:
                 dq.clear()
 
     # ---- retry budget + backoff --------------------------------------------
 
+    def _budget_key(self) -> tuple:
+        from . import sessionctx
+        return (sessionctx.session_key(), threading.get_ident())
+
     def start_plan_attempt(self) -> None:
-        """Refill this thread's retry budget (one budget per plan attempt;
-        per-thread so concurrent plans on a shared monitor stay bounded
-        independently)."""
-        self._local.budget = self.retry_budget
+        """Refill this plan attempt's retry budget (keyed by session x
+        thread — see __init__: tenants never alias across a shared
+        worker thread, and one tenant's concurrent plans never refill or
+        starve each other's bound mid-plan)."""
+        with self._lock:
+            self._budgets[self._budget_key()] = self.retry_budget
 
     def try_retry(self, attempt: int) -> Optional[float]:
         """Consume one unit of the plan attempt's retry budget and sleep a
         jittered exponential backoff for retry number `attempt` (0-based).
         Returns the milliseconds slept, or None when the budget is
         exhausted (the caller must escalate, not retry)."""
-        budget = getattr(self._local, "budget", self.retry_budget)
-        if budget <= 0:
-            with self._lock:
+        key = self._budget_key()
+        with self._lock:
+            budget = self._budgets.get(key)
+            if budget is None:
+                budget = self.retry_budget
+            if budget <= 0:
                 self._metrics["budget_exhausted"] += 1
-            return None
-        self._local.budget = budget - 1
+                return None
+            self._budgets[key] = budget - 1
         delay_ms = min(self.backoff_max_ms,
                        self.backoff_base_ms * (2 ** attempt))
         delay_ms *= self._rng.uniform(0.5, 1.0)   # jitter: decorrelate peers

@@ -1,0 +1,23 @@
+"""Multi-tenant serving layer (docs/serving.md).
+
+`ServingScheduler` is the front door: N tenant sessions submit plans to
+a bounded queue; a fair-share dispatcher (weighted deficit round-robin
+over priority lanes, starvation-bounded) admits them through the health
+monitor with per-session memory quotas sized by the static resource
+certifier, exerts backpressure when the queue saturates, keys retry
+budgets per tenant, and serves repeat traffic from a fingerprint +
+data-digest result cache.
+
+    from spark_rapids_tpu.serving import ServingScheduler
+
+    with ServingScheduler() as sched:
+        tenant = sched.open_session(priority="interactive")
+        res = tenant.run(plan, {"t": table})
+"""
+from .cache import ResultCache, cache_key, cached_copy, input_digest
+from .scheduler import (PRIORITIES, ServingRejectedError, ServingScheduler,
+                        ServingSession, Ticket)
+
+__all__ = ["ServingScheduler", "ServingSession", "Ticket",
+           "ServingRejectedError", "ResultCache", "cache_key",
+           "cached_copy", "input_digest", "PRIORITIES"]

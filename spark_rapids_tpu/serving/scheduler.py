@@ -1,0 +1,656 @@
+"""Multi-tenant serving layer: fair-share session scheduler with quota
+admission, backpressure, and overload-graceful degradation
+(docs/serving.md).
+
+This is the paper's SparkResourceAdaptor story — many concurrent tasks
+share one device without deadlock or starvation (PAPER.md §0) — promoted
+to whole-plan traffic: the front door the `runtime/` arbitration
+machinery (admission, retry budgets, breaker, spill) never had. N tenant
+sessions submit plans; a bounded queue + a small dispatcher worker pool
+execute them through ONE shared `PlanExecutor`, so the compiled-program
+caches, the health monitor, and the stats store are genuinely shared
+across tenants while every per-tenant bound stays per-tenant:
+
+- **fair share** — weighted deficit round-robin over the sessions of
+  each priority lane (interactive > normal > batch), one deficit credit
+  per dispatched plan scaled by the session weight; an AGING bound
+  (`SPARK_RAPIDS_TPU_SERVING_STARVATION_MS`) dispatches any plan that
+  has waited too long regardless of lane or deficit, so weighted
+  fairness can skew throughput but never unbound a session's queue wait;
+- **quota admission** — every submission is charged
+  `footprint.quota_charge(cert, default)` bytes against its session's
+  device-memory quota: the PR 12 certifier's sound `peak_bytes_hi` when
+  the plan is bounded, a flat configurable default when it is not. A
+  charge that can NEVER fit the session quota rejects (typed, naming
+  session + the operator that set the certified peak, before any
+  compilation) or pins the plan to the CPU tier, per
+  `SPARK_RAPIDS_TPU_SERVING_OVER_QUOTA`; a charge that fits but is
+  currently crowded out just waits — the dispatcher skips the session
+  until its in-flight charges drain;
+- **backpressure** — the queue is bounded; a full queue blocks submit()
+  (or fast-rejects, caller-selectable) instead of hiding overload until
+  memory does the rejecting (StreamBox-HBM's bounded-pipeline
+  discipline, PAPERS.md);
+- **per-session retry budgets** — every job executes inside
+  `sessionctx.session_scope`, so the health monitor's retry budgets and
+  sticky windows key on the TENANT (runtime/health.py): one pathological
+  session exhausts its own budget, never a neighbour's;
+- **breaker-aware dispatch** — an open breaker never stalls the queue:
+  the executor's admission gate routes each dispatched plan to the
+  degraded CPU tier (parity-exact) until the half-open probe closes the
+  breaker, at which point device dispatch resumes on the very next job;
+- **result cache** — completed results key by canonical fingerprint +
+  input-data digest (serving/cache.py, LRU + TTL); hits serve deep-
+  copied results stamped `cached=True` without consuming queue, quota,
+  or a worker.
+
+Concurrency note: this layer is the first real multi-plan concurrency
+the engine sees — one session's streaming-scan prefetch thread decoding
+chunks while another session's plan executes on the device is the PR 4
+overlap promoted across tenants.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from typing import Deque, Dict, List, Optional
+
+from . import cache as cache_mod
+
+__all__ = ["ServingScheduler", "ServingSession", "Ticket",
+           "ServingRejectedError", "PRIORITIES"]
+
+# priority lanes, served strictly in order (aging outranks lanes)
+PRIORITIES = {"interactive": 0, "normal": 1, "batch": 2}
+
+
+class ServingRejectedError(RuntimeError):
+    """Typed fast-reject from the serving layer. `reason` is machine-
+    checkable ("queue_full" | "over_quota" | "closed"); `session` and
+    `operator` (the label that set the certified peak, over-quota only)
+    make the diagnostic attributable without parsing the message."""
+
+    def __init__(self, reason: str, detail: str, *,
+                 session: Optional[str] = None, operator: str = ""):
+        at = f" [session={session}]" if session else ""
+        op = f" [operator={operator}]" if operator else ""
+        super().__init__(f"{reason}{at}{op}: {detail}")
+        self.reason = reason
+        self.session = session
+        self.operator = operator
+
+
+class Ticket:
+    """One submitted plan's handle: `result()` blocks for the outcome
+    (re-raising the execution error, if any); `queue_wait_ms` and
+    `cached` are the serving-side observability stamps."""
+
+    def __init__(self, session_id: str):
+        self.session = session_id
+        self.queue_wait_ms: float = 0.0
+        self.cached = False
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"serving ticket [session={self.session}] not complete "
+                f"after {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def _complete(self, result=None, error: Optional[BaseException] = None):
+        self._result = result
+        self._error = error
+        self._event.set()
+
+
+class _SessionState:
+    """Dispatcher-side per-session bookkeeping (all fields guarded by the
+    scheduler lock)."""
+
+    def __init__(self, sid: str, weight: float, priority: str,
+                 quota_bytes: int):
+        self.id = sid
+        self.weight = weight
+        self.priority = priority
+        self.lane = PRIORITIES[priority]
+        self.quota_bytes = quota_bytes
+        self.deficit = 0.0
+        self.in_flight_bytes = 0
+        self.queue: Deque["_Job"] = collections.deque()
+        # accounting for metrics()/the soak's per-session assertions
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.rejected = 0
+        self.degraded = 0
+        self.retries = 0
+        self.cache_hits = 0
+        self.wait_ms: List[float] = []       # per-dispatch queue waits
+        self.aged_dispatches = 0             # starvation-bound promotions
+        self.active_jobs = 0                 # dispatched, not yet completed
+        self.closed = False
+
+    def wait_stats(self) -> Dict[str, float]:
+        if not self.wait_ms:
+            return {"max": 0.0, "p99": 0.0, "mean": 0.0}
+        s = sorted(self.wait_ms)
+        return {"max": s[-1],
+                "p99": s[min(len(s) - 1, int(0.99 * len(s)))],
+                "mean": sum(s) / len(s)}
+
+
+class _Job:
+    __slots__ = ("plan", "inputs", "state", "ticket", "charge",
+                 "charge_source", "op_label", "tier", "cache_key",
+                 "enqueued_at")
+
+    def __init__(self, plan, inputs, state: _SessionState, ticket: Ticket,
+                 charge: int, charge_source: str, op_label: str, tier: str,
+                 cache_key, enqueued_at: float):
+        self.plan = plan
+        self.inputs = inputs
+        self.state = state
+        self.ticket = ticket
+        self.charge = charge
+        self.charge_source = charge_source
+        self.op_label = op_label
+        self.tier = tier                  # "device" | "cpu" (quota-degraded)
+        self.cache_key = cache_key
+        self.enqueued_at = enqueued_at
+
+
+class ServingSession:
+    """One tenant's handle onto the scheduler: `submit()` enqueues and
+    returns a Ticket, `run()` is the submit+wait convenience. Closing a
+    session only bars NEW submissions — queued work drains normally."""
+
+    def __init__(self, scheduler: "ServingScheduler", state: _SessionState):
+        self._scheduler = scheduler
+        self._state = state
+        self.id = state.id
+
+    def submit(self, plan, inputs: Optional[Dict] = None, *,
+               block: Optional[bool] = None,
+               timeout: Optional[float] = None) -> Ticket:
+        return self._scheduler._submit(self._state, plan, inputs,
+                                       block=block, timeout=timeout)
+
+    def run(self, plan, inputs: Optional[Dict] = None, *,
+            block: Optional[bool] = None,
+            timeout: Optional[float] = None):
+        """submit + wait under ONE deadline: whatever the blocked submit
+        consumed of `timeout` is not granted to the result wait again."""
+        t0 = time.monotonic()
+        ticket = self.submit(plan, inputs, block=block, timeout=timeout)
+        remaining = (None if timeout is None
+                     else max(0.0, timeout - (time.monotonic() - t0)))
+        return ticket.result(remaining)
+
+    def close(self) -> None:
+        self._scheduler._close_session(self._state)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class ServingScheduler:
+    """The serving front door: N sessions, one device, bounded queue,
+    fair-share dispatch (see the module docstring for the contract).
+
+    Pass an existing `PlanExecutor` to share its health monitor and
+    program caches with non-serving callers; by default the scheduler
+    owns an eager-tier executor. All knob parameters default from the
+    `SPARK_RAPIDS_TPU_SERVING_*` family (config.py), read once at
+    construction (one policy per scheduler lifetime, the health-monitor
+    convention)."""
+
+    _ids = itertools.count(1)
+
+    def __init__(self, executor=None, *,
+                 workers: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 starvation_ms: Optional[float] = None,
+                 cache_entries: Optional[int] = None,
+                 cache_ttl_s: Optional[float] = None,
+                 quota_bytes: Optional[int] = None,
+                 default_charge_bytes: Optional[int] = None,
+                 over_quota: Optional[str] = None,
+                 backpressure: Optional[str] = None,
+                 clock=time.monotonic):
+        from .. import config
+        from ..plan.executor import PlanExecutor
+        self.executor = executor if executor is not None \
+            else PlanExecutor(mode="eager")
+        self.workers = (config.serving_workers() if workers is None
+                        else max(1, int(workers)))
+        self.queue_depth = (config.serving_queue_depth()
+                            if queue_depth is None
+                            else max(1, int(queue_depth)))
+        self.starvation_ms = (config.serving_starvation_ms()
+                              if starvation_ms is None
+                              else float(starvation_ms))
+        self.default_quota_bytes = (config.serving_quota_bytes()
+                                    if quota_bytes is None
+                                    else int(quota_bytes))
+        self.default_charge_bytes = (config.serving_default_charge_bytes()
+                                     if default_charge_bytes is None
+                                     else int(default_charge_bytes))
+        self.over_quota = (config.serving_over_quota()
+                           if over_quota is None else over_quota)
+        if self.over_quota not in ("reject", "degrade"):
+            raise ValueError(f"unknown over_quota policy "
+                             f"{self.over_quota!r} (expected reject or "
+                             "degrade)")
+        bp = (config.serving_backpressure() if backpressure is None
+              else backpressure)
+        if bp not in ("block", "reject"):
+            raise ValueError(f"unknown backpressure policy {bp!r} "
+                             "(expected block or reject)")
+        self.block_default = bp == "block"
+        self.cache = cache_mod.ResultCache(entries=cache_entries,
+                                           ttl_s=cache_ttl_s, clock=clock)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._lock_cond = threading.Condition(self._lock)
+        self._sessions: Dict[str, _SessionState] = {}
+        self._rr: Dict[int, int] = {}     # per-lane round-robin cursor
+        self._queued = 0
+        self._queued_hiwater = 0
+        self._active = 0                  # jobs dispatched, not yet done
+        self._closed = False
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"srt-serving-{i}")
+            for i in range(self.workers)]
+        for t in self._threads:
+            t.start()
+
+    # ---- sessions ----------------------------------------------------------
+
+    def open_session(self, session_id: Optional[str] = None, *,
+                     weight: float = 1.0, priority: str = "normal",
+                     quota_bytes: Optional[int] = None) -> ServingSession:
+        if priority not in PRIORITIES:
+            raise ValueError(f"unknown priority {priority!r} (expected "
+                             f"one of {sorted(PRIORITIES)})")
+        if weight <= 0:
+            raise ValueError(f"session weight must be > 0, got {weight}")
+        with self._lock:
+            if self._closed:
+                raise ServingRejectedError(
+                    "closed", "scheduler is shut down")
+            sid = session_id or f"s{next(self._ids)}"
+            old = self._sessions.get(sid)
+            if old is not None and not old.closed:
+                raise ValueError(f"session id {sid!r} already open")
+            if old is not None and old.queue:
+                # reopening would orphan the old state's queued jobs: the
+                # dispatcher discovers work only through self._sessions,
+                # so replacing the entry now would strand those tickets
+                # forever while _queued still counts them
+                raise ValueError(f"session id {sid!r} is closed but still "
+                                 f"draining {len(old.queue)} queued "
+                                 "plan(s); reopen after they complete")
+            state = _SessionState(
+                sid, float(weight), priority,
+                self.default_quota_bytes if quota_bytes is None
+                else int(quota_bytes))
+            self._sessions[sid] = state
+        return ServingSession(self, state)
+
+    def _close_session(self, state: _SessionState) -> None:
+        with self._lock:
+            state.closed = True
+            self._maybe_reap_locked(state)
+
+    def _maybe_reap_locked(self, state: _SessionState) -> None:
+        """Drop a closed, fully-drained session from the map: a
+        long-running scheduler serving short-lived tenants must not
+        accumulate one _SessionState (deque + counters + wait samples)
+        per tenant ever opened — _pick_locked iterates the map under the
+        dispatch lock on every pick, so leaked sessions are latency, not
+        just memory. Waits for queued AND dispatched work (a CPU-pinned
+        job carries zero in-flight charge, so bytes alone cannot prove
+        quiescence). Reaped ids disappear from metrics(); callers wanting
+        a tenant's final numbers read them before close()."""
+        if state.closed and not state.queue and \
+                state.active_jobs == 0 and \
+                self._sessions.get(state.id) is state:
+            del self._sessions[state.id]
+
+    # ---- submission --------------------------------------------------------
+
+    def _bind(self, plan, inputs: Optional[Dict]) -> Dict:
+        """The executor's OWN scan-binding prologue (one definition —
+        plan/executor.bind_scan_sources), applied here so the cache
+        digest and quota charge see exactly the binding execute() will."""
+        from ..plan.executor import bind_scan_sources
+        return bind_scan_sources(plan, inputs)
+
+    def _certify(self, plan, inputs: Dict):
+        """Certify the AUTHORED plan through the executor's memoized walk
+        — quota must resolve BEFORE any optimization/compilation, so the
+        charge is deliberately the authored plan's bound (the optimizer
+        may only keep or tighten it — certifier monotonicity, docs/
+        analysis.md); repeat submissions of the same (plan, binding)
+        share the memo, execute()'s own cert of the REWRITTEN plan is a
+        separate (also memoized) walk. Defensive None on any error:
+        sizing must never fail a submission the executor would accept
+        (missing inputs etc. surface at execution, against
+        executor-owned diagnostics)."""
+        try:
+            bound = {name: tuple(t.names) for name, t in inputs.items()}
+            return self.executor._certify(plan, inputs, bound)
+        except Exception:
+            return None
+
+    def _submit(self, state: _SessionState, plan, inputs: Optional[Dict],
+                *, block: Optional[bool], timeout: Optional[float]) -> Ticket:
+        from ..analysis.footprint import quota_charge
+        if self._closed or state.closed:
+            # early unlocked read: a submit racing close() is still
+            # caught by the locked re-check at enqueue below; this just
+            # keeps cache hits from serving through a closed front door
+            raise ServingRejectedError(
+                "closed", "session or scheduler is shut down",
+                session=state.id)
+        if block is None:
+            block = self.block_default
+        inputs = self._bind(plan, inputs)
+        ticket = Ticket(state.id)
+        key = cache_mod.cache_key(plan, inputs) \
+            if self.cache.entries > 0 else None
+        hit = self.cache.get(key)
+        if hit is not None:
+            # a hit consumes nothing: no queue slot, no quota, no worker
+            hit.session = state.id
+            for m in hit.metrics.values():
+                m.session = state.id
+            ticket.cached = True
+            with self._lock:
+                state.submitted += 1
+                state.completed += 1
+                state.cache_hits += 1
+            ticket._complete(result=hit)
+            return ticket
+        cert = self._certify(plan, inputs)
+        charge, source, op_label = quota_charge(cert,
+                                                self.default_charge_bytes)
+        tier = "device"
+        if charge > state.quota_bytes:
+            # can NEVER fit this session's quota: resolve now, before any
+            # compilation — reject with an attributable diagnostic, or pin
+            # to the CPU tier where the device quota does not bind
+            if self.over_quota == "reject":
+                with self._lock:
+                    state.submitted += 1
+                    state.rejected += 1
+                raise ServingRejectedError(
+                    "over_quota",
+                    f"plan charges {charge} B ({source}) against a "
+                    f"{state.quota_bytes} B session quota",
+                    session=state.id, operator=op_label)
+            tier, charge = "cpu", 0
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._lock_cond:
+            if self._closed or state.closed:
+                raise ServingRejectedError(
+                    "closed", "session or scheduler is shut down",
+                    session=state.id)
+            while self._queued >= self.queue_depth:
+                if not block:
+                    state.submitted += 1
+                    state.rejected += 1
+                    raise ServingRejectedError(
+                        "queue_full",
+                        f"{self._queued} plans queued (depth "
+                        f"{self.queue_depth}); backpressure policy is "
+                        "fast-reject", session=state.id)
+                remaining = (None if deadline is None
+                             else deadline - self._clock())
+                if remaining is not None and remaining <= 0:
+                    state.submitted += 1
+                    state.rejected += 1
+                    raise ServingRejectedError(
+                        "queue_full",
+                        f"queue stayed full past the {timeout}s submit "
+                        "timeout", session=state.id)
+                self._lock_cond.wait(timeout=0.05 if remaining is None
+                                else min(0.05, remaining))
+                if self._closed or state.closed:
+                    raise ServingRejectedError(
+                        "closed", "session or scheduler shut down while "
+                        "submit was blocked", session=state.id)
+            job = _Job(plan, inputs, state, ticket, charge, source,
+                       op_label, tier, key, self._clock())
+            state.queue.append(job)
+            state.submitted += 1
+            self._queued += 1
+            self._queued_hiwater = max(self._queued_hiwater, self._queued)
+            self._lock_cond.notify_all()
+        return ticket
+
+    # ---- dispatch ----------------------------------------------------------
+
+    def _eligible(self, state: _SessionState) -> bool:
+        """Head-of-line job can dispatch now: CPU-pinned jobs always (no
+        device charge), device jobs when the session's in-flight charges
+        leave room under its quota."""
+        if not state.queue:
+            return False
+        job = state.queue[0]
+        return job.tier == "cpu" or \
+            state.in_flight_bytes + job.charge <= state.quota_bytes
+
+    def _pick_locked(self) -> Optional[_Job]:
+        """Next job to dispatch (scheduler lock held).
+
+        1. Starvation aging: the oldest eligible head waiting past
+           `starvation_ms` wins outright — bounded queue wait for every
+           session, whatever the lanes/weights say.
+        2. Priority lanes in order; weighted deficit round-robin within a
+           lane: each pass over the lane's eligible sessions grants
+           `weight` credit, a dispatch costs 1 credit — over time a
+           weight-2 session dispatches twice per weight-1 session's once.
+        """
+        eligible = [s for s in self._sessions.values() if self._eligible(s)]
+        if not eligible:
+            return None
+        now = self._clock()
+        if self.starvation_ms > 0:
+            starved = [s for s in eligible
+                       if (now - s.queue[0].enqueued_at) * 1e3
+                       >= self.starvation_ms]
+            if starved:
+                s = min(starved, key=lambda s: s.queue[0].enqueued_at)
+                s.aged_dispatches += 1
+                return self._take_locked(s)
+        lanes: Dict[int, List[_SessionState]] = {}
+        for s in eligible:
+            lanes.setdefault(s.lane, []).append(s)
+        for lane in sorted(lanes):
+            members = sorted(lanes[lane], key=lambda s: s.id)
+            cursor = self._rr.get(lane, 0)
+            # rotate so round-robin order persists across picks
+            members = members[cursor % len(members):] + \
+                members[:cursor % len(members)]
+            for _ in range(64):     # bounded credit rounds (weights >= eps)
+                for i, s in enumerate(members):
+                    if s.deficit >= 1.0:
+                        s.deficit -= 1.0
+                        self._rr[lane] = (cursor + i + 1) % len(members)
+                        return self._take_locked(s)
+                for s in members:
+                    s.deficit = min(s.deficit + s.weight, 64.0)
+        return None
+
+    def _take_locked(self, state: _SessionState) -> _Job:
+        job = state.queue.popleft()
+        self._queued -= 1
+        if job.tier != "cpu":
+            state.in_flight_bytes += job.charge
+        state.active_jobs += 1
+        self._active += 1
+        self._lock_cond.notify_all()
+        return job
+
+    def _worker(self) -> None:
+        while True:
+            with self._lock_cond:
+                job = None
+                while job is None:
+                    if self._closed and self._queued == 0:
+                        return
+                    job = self._pick_locked()
+                    if job is None:
+                        # timed wait, not pure signal-driven: aging
+                        # promotions and quota releases become pickable
+                        # with time, and a missed notify must never
+                        # strand a queued job
+                        self._lock_cond.wait(timeout=0.05)
+            self._run_job(job)
+
+    def _run_job(self, job: _Job) -> None:
+        from ..runtime import sessionctx
+        state = job.state
+        wait_ms = (self._clock() - job.enqueued_at) * 1e3
+        job.ticket.queue_wait_ms = wait_ms
+        result = error = None
+        served_hit = False
+        # EVERYTHING between dispatch and the finally must leave the
+        # worker alive and the ticket completed: an unguarded raise here
+        # (cache copy under memory pressure, say) would kill the
+        # dispatcher thread, leak _active/in_flight accounting (close()
+        # then never drains), and strand the submitter's result() forever
+        try:
+            # dispatch-time cache consult: a repeat plan that QUEUED
+            # behind its twin (both submitted before either completed —
+            # the common shape of a burst of identical traffic) still
+            # serves the first completion's result instead of
+            # re-executing
+            # count_miss=False: submit() already counted this key's
+            # miss once — the dispatch-time re-consult is burst dedup,
+            # not new traffic, and must not halve the reported hit rate
+            hit = self.cache.get(job.cache_key, count_miss=False)
+            if hit is not None:
+                hit.session = state.id
+                for m in hit.metrics.values():
+                    m.session = state.id
+                job.ticket.cached = True
+                served_hit = True
+                result = hit
+            else:
+                with sessionctx.session_scope(state.id):
+                    result = self.executor.execute(
+                        job.plan, job.inputs,
+                        tier="cpu" if job.tier == "cpu" else None)
+                if job.cache_key is not None and not result.degraded:
+                    # device-tier results only: a degraded result is a
+                    # transient-condition artifact (breaker open, quota
+                    # pin) whose degraded=True stamp would keep reporting
+                    # CPU-tier completions to healthy-device traffic for
+                    # the whole TTL. The cache is an optimization —
+                    # failing to store must not fail the job.
+                    try:
+                        self.cache.put(job.cache_key, result)
+                    except Exception:
+                        pass
+        except BaseException as e:
+            error = e
+        finally:
+            with self._lock:
+                if job.tier != "cpu":
+                    state.in_flight_bytes -= job.charge
+                state.active_jobs -= 1
+                self._active -= 1
+                state.wait_ms.append(wait_ms)
+                if len(state.wait_ms) > 10_000:
+                    del state.wait_ms[:5_000]     # bounded sample memory
+                if error is None and result is not None:
+                    state.completed += 1
+                    if served_hit:
+                        state.cache_hits += 1
+                    else:
+                        state.retries += result.retries
+                        if result.degraded or job.tier == "cpu":
+                            state.degraded += 1
+                else:
+                    state.failed += 1
+                self._maybe_reap_locked(state)
+                self._lock_cond.notify_all()
+            job.ticket._complete(result=result, error=error)
+
+    # ---- lifecycle / observability -----------------------------------------
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Shut down: `drain=True` (default) serves everything already
+        queued, then stops; `drain=False` fails queued jobs with a typed
+        `ServingRejectedError("closed")` immediately. Either way no new
+        submission is accepted from the moment of the call."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._lock_cond:
+            self._closed = True
+            if not drain:
+                for state in self._sessions.values():
+                    while state.queue:
+                        job = state.queue.popleft()
+                        self._queued -= 1
+                        job.ticket._complete(error=ServingRejectedError(
+                            "closed", "scheduler shut down before "
+                            "dispatch", session=state.id))
+            self._lock_cond.notify_all()
+            while self._queued > 0 or self._active > 0:
+                remaining = (None if deadline is None
+                             else deadline - self._clock())
+                if remaining is not None and remaining <= 0:
+                    break
+                self._lock_cond.wait(timeout=0.05 if remaining is None
+                                else min(0.05, remaining))
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def metrics(self) -> Dict:
+        """Snapshot: per-session accounting + queue/cache aggregates (the
+        soak's assertion surface, docs/serving.md#observability)."""
+        with self._lock:
+            sessions = {
+                s.id: {"weight": s.weight, "priority": s.priority,
+                       "quota_bytes": s.quota_bytes,
+                       "in_flight_bytes": s.in_flight_bytes,
+                       "queued": len(s.queue), "submitted": s.submitted,
+                       "completed": s.completed, "failed": s.failed,
+                       "rejected": s.rejected, "degraded": s.degraded,
+                       "retries": s.retries, "cache_hits": s.cache_hits,
+                       "aged_dispatches": s.aged_dispatches,
+                       "queue_wait_ms": s.wait_stats()}
+                for s in self._sessions.values()}
+            queued, hiwater = self._queued, self._queued_hiwater
+        return {"sessions": sessions,
+                "queued": queued,
+                "queue_hiwater": hiwater,
+                "queue_depth": self.queue_depth,
+                "workers": self.workers,
+                "cache": self.cache.stats(),
+                "breaker": self.executor.health.breaker.state}

@@ -239,6 +239,69 @@ def test_retry_budget_is_per_thread():
     assert hm.try_retry(0) is None            # still exhausted here
 
 
+def test_retry_budget_keyed_by_session_not_thread():
+    """Serving-layer aliasing regression (docs/serving.md): one worker
+    thread multiplexed across two tenants must give each its own retry
+    budget — before session keying, tenant B inherited whatever tenant A
+    left of the THREAD's budget."""
+    from spark_rapids_tpu.runtime import sessionctx
+    hm = _monitor(retry_budget=2, backoff_base_ms=1)
+    with sessionctx.session_scope("tenant-a"):
+        hm.start_plan_attempt()
+        assert hm.try_retry(0) is not None and hm.try_retry(0) is not None
+        assert hm.try_retry(0) is None        # tenant A: exhausted
+    with sessionctx.session_scope("tenant-b"):
+        hm.start_plan_attempt()
+        # same thread, different tenant: fresh bound, NOT A's residue
+        assert hm.try_retry(0) is not None
+    with sessionctx.session_scope("tenant-a"):
+        # and B's refill must not have resurrected A's budget
+        assert hm.try_retry(0) is None
+
+
+def test_same_tenant_concurrent_plans_keep_independent_budgets():
+    """ONE tenant with two in-flight plans on different workers (the
+    normal serving shape): each plan attempt keeps its OWN bounded
+    budget — plan 2's start_plan_attempt must not refill plan 1's bound
+    mid-plan, and plan 1's retries must not starve plan 2's first."""
+    import threading
+    from spark_rapids_tpu.runtime import sessionctx
+    hm = _monitor(retry_budget=2, backoff_base_ms=1)
+    with sessionctx.session_scope("tenant-a"):
+        hm.start_plan_attempt()
+        assert hm.try_retry(0) is not None and hm.try_retry(0) is not None
+        assert hm.try_retry(0) is None        # this plan: exhausted
+    got = {}
+
+    def worker2():
+        with sessionctx.session_scope("tenant-a"):
+            hm.start_plan_attempt()           # its own plan attempt
+            got["fresh"] = hm.try_retry(0) is not None
+
+    t = threading.Thread(target=worker2)
+    t.start(); t.join()
+    assert got["fresh"]                       # independently bounded...
+    with sessionctx.session_scope("tenant-a"):
+        # ...and worker 2's refill did not resurrect THIS plan's budget
+        assert hm.try_retry(0) is None
+
+
+def test_sticky_windows_keyed_by_session():
+    """Tenant A's repeated failures of an op must not arm a sticky trip
+    against tenant B's FIRST failure of the same op."""
+    from spark_rapids_tpu.runtime import sessionctx
+    clock = _FakeClock()
+    hm = _monitor(clock=clock, sticky_threshold=2, sticky_window_s=60)
+    e = faultinj.DeviceAssertError("x")
+    with sessionctx.session_scope("tenant-a"):
+        assert hm.record_failure("HashJoin#1", e) == TRANSIENT
+    with sessionctx.session_scope("tenant-b"):
+        # B's first failure of this op: transient, whatever A did
+        assert hm.record_failure("HashJoin#1", e) == TRANSIENT
+    with sessionctx.session_scope("tenant-a"):
+        assert hm.record_failure("HashJoin#1", e) == STICKY
+
+
 def test_breaker_probe_exception_counts_as_failure():
     def boom():
         raise faultinj.DeviceFatalError("still dead")

@@ -1,0 +1,231 @@
+"""Concurrency audit: faultinj install/uninstall + StatsStore recording
+under concurrent in-flight plans (ISSUE 15 satellite — the PR 12 locks
+existed but were never exercised by >1 plan at once).
+
+What the stress threads actually race:
+
+- the fault injector's interception surface (rule draw, the injected
+  counter, poisoned-device flag) against 8 threads of plan executions
+  AND a flapping install()/uninstall() cycle on a 9th;
+- a single shared StatsStore receiving record_result from every thread
+  (generation monotonicity, table integrity, JSONL append atomicity);
+- one shared PlanExecutor's LruDict-backed memo caches (rewrite,
+  verify, cert, compiled-program) — the pop/reinsert recency dance is
+  the classic lost-update window.
+
+Assertions are invariants, not schedules: no unexpected exception, exact
+record/generation accounting, per-line-valid JSONL, the ops surface
+restored shim-free after the final uninstall.
+"""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import spark_rapids_tpu  # noqa: F401
+from spark_rapids_tpu import Column, Table, dtypes, faultinj
+from spark_rapids_tpu.plan import PlanBuilder, PlanExecutor, col
+from spark_rapids_tpu.plan import stats as stats_mod
+from spark_rapids_tpu.utils.lru import LruDict
+
+
+def _col(a):
+    a = np.asarray(a, dtype=np.int64)
+    return Column(dtype=dtypes.INT64, length=len(a), data=jnp.asarray(a))
+
+
+def _table(n, seed):
+    rng = np.random.default_rng(seed)
+    return Table([_col(rng.integers(0, 40, n)),
+                  _col(rng.integers(1, 100, n))], names=["k", "v"])
+
+
+def _plan():
+    b = PlanBuilder()
+    return (b.scan("t", schema=["k", "v"]).filter(col("v") > 5)
+            .aggregate(["k"], [("v", "sum", "total"),
+                               ("v", "max", "peak")])
+            .sort(["k"]).build())
+
+
+@pytest.fixture
+def _clean_faultinj():
+    yield
+    faultinj.uninstall()
+
+
+def test_lru_dict_concurrent_get_never_drops_entries():
+    """The recency refresh (pop + reinsert) under concurrent get():
+    before the internal lock, two threads hitting one key raced the pop
+    and the loser raised KeyError (or the entry vanished)."""
+    d = LruDict(64)
+    for i in range(32):
+        d[i] = i * 10
+    errors = []
+
+    def hammer(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            for _ in range(4000):
+                k = int(rng.integers(0, 32))
+                v = d.get(k)
+                assert v is None or v == k * 10
+                if rng.integers(0, 4) == 0:
+                    d[k] = k * 10
+        except Exception as e:          # pragma: no cover - the regression
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert len(d) <= 64
+    for i in range(32):
+        assert d.get(i) == i * 10       # nothing was silently dropped
+
+
+def test_concurrent_sessions_stats_store_consistency(tmp_path):
+    """8 threads, one shared executor + one shared StatsStore: every
+    successful execution records exactly once (generation == records),
+    the persisted JSONL has one valid line per record (append
+    atomicity), and results stay bit-exact per thread."""
+    plan = _plan()
+    tables = {i: _table(600 + 8 * i, seed=i) for i in range(8)}
+    solo = PlanExecutor(mode="eager", optimize=True)
+    refs = {i: solo.execute(plan, {"t": t}).table.to_pydict()
+            for i, t in tables.items()}
+    path = str(tmp_path / "stats.jsonl")
+    store = stats_mod.StatsStore(capacity=64, path=path)
+    ex = PlanExecutor(mode="eager")
+    runs_per_thread = 6
+    errors = []
+
+    def worker(i):
+        try:
+            with stats_mod.scoped_store(store):
+                for _ in range(runs_per_thread):
+                    res = ex.execute(plan, {"t": tables[i]})
+                    assert res.table.to_pydict() == refs[i]
+        except Exception as e:
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert store.generation == 8 * runs_per_thread
+    with open(path) as f:
+        lines = f.readlines()
+    assert len(lines) == 8 * runs_per_thread
+    for line in lines:
+        ev = json.loads(line)           # no torn/interleaved appends
+        assert ev["backend"] == jax.default_backend()
+
+
+def test_faultinj_flapping_install_under_concurrent_plans(tmp_path,
+                                                          _clean_faultinj):
+    """install()/uninstall() cycling while 6 threads execute plans: no
+    lost originals, no crash beyond the injected taxonomy, and the ops
+    surface comes back shim-free after the final uninstall."""
+    cfg = tmp_path / "inj.json"
+    cfg.write_text(json.dumps({"seed": 7, "computeFaults": {
+        "plan.HashAggregate": {"percent": 20, "injectionType": 1,
+                               "interceptionCount": 100000}}}))
+    plan = _plan()
+    tables = {i: _table(500, seed=100 + i) for i in range(6)}
+    solo = PlanExecutor(mode="eager")
+    refs = {i: solo.execute(plan, {"t": t}).table.to_pydict()
+            for i, t in tables.items()}
+    ex = PlanExecutor(mode="eager")
+    ex.health.backoff_base_ms = 0.01
+    ex.health.backoff_max_ms = 0.05
+    stop = threading.Event()
+    errors = []
+
+    def flapper():
+        try:
+            while not stop.is_set():
+                faultinj.install(str(cfg))
+                faultinj.uninstall()
+        except Exception as e:
+            errors.append(("flapper", e))
+
+    def worker(i):
+        try:
+            for _ in range(8):
+                res = ex.execute(plan, {"t": tables[i]})
+                assert res.table.to_pydict() == refs[i]
+        except Exception as e:
+            errors.append((i, e))
+
+    fl = threading.Thread(target=flapper)
+    ths = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    fl.start()
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    stop.set()
+    fl.join()
+    assert not errors, errors
+    faultinj.uninstall()
+    from spark_rapids_tpu import ops
+    for name in ops.__all__:
+        fn = getattr(ops, name)
+        assert not hasattr(fn, "__faultinj_shim__"), \
+            f"uninstall left a live shim on ops.{name}"
+    assert faultinj.active() is None
+
+
+def test_fatal_poison_flag_is_atomic_under_contention(tmp_path,
+                                                      _clean_faultinj):
+    """A fatal injection and a racing reset_device() leave the injector
+    in a coherent state: the fatal either poisons (later calls refuse)
+    or the reset lands after it — never a counted-but-unpoisoned tear."""
+    cfg = tmp_path / "fatal.json"
+    cfg.write_text(json.dumps({"seed": 1, "computeFaults": {
+        "boom": {"percent": 100, "injectionType": 0,
+                 "interceptionCount": 1000000}}}))
+    inj = faultinj.install(str(cfg))
+    hits = {"fatal": 0}
+    lock = threading.Lock()
+
+    def attacker():
+        for _ in range(300):
+            try:
+                inj.on_compute("boom")
+            except faultinj.DeviceFatalError:
+                with lock:
+                    hits["fatal"] += 1
+
+    def resetter():
+        for _ in range(300):
+            inj.reset_device()
+
+    ths = [threading.Thread(target=attacker) for _ in range(4)] + \
+        [threading.Thread(target=resetter)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    assert hits["fatal"] > 0
+    # every COUNTED injection raised (poisoned-device refusals raise the
+    # same error without counting, so injected <= raised) and the racing
+    # resets never tore the counter to zero
+    drained = inj.get_and_reset_injected()
+    assert 0 < drained <= hits["fatal"]
+    inj.reset_device()
+    hits2 = 0
+    try:
+        inj.on_compute("health.probe")   # unmatched key: no rule fires
+    except faultinj.DeviceFatalError:    # pragma: no cover
+        hits2 = 1
+    assert hits2 == 0

@@ -23,6 +23,11 @@ Two AST rules over ``benchmarks/`` and ``bench.py``:
   counter into wire vs logical; a wire number silently compared against
   a logical one is the same class of trajectory bug as a missing
   backend stamp).
+- ``missing-session-stamp``: a call that stamps ``queue_wait_ms=`` or
+  ``cache_hit=`` must also stamp ``session=`` (serving-layer records,
+  docs/serving.md: a queue wait or a cache-served number without its
+  tenant session is not attributable — and a cached row measured no
+  execution at all, so consumers must be able to filter it).
 - ``raw-jsonl-missing-stamp``: a ``json.dumps({...literal...})`` record
   must carry ``"backend"`` and ``"kernels"`` keys — unless it carries an
   ``"error"`` key (failure records describe infrastructure, not
@@ -94,6 +99,13 @@ def _lint_file(path: str, rel: str, findings: List[str]) -> None:
                     "wire number silently compared against a logical "
                     "one is not comparable (plan/transport.py, "
                     "docs/distributed.md#transport)")
+            if kw & {"queue_wait_ms", "cache_hit"} and "session" not in kw:
+                findings.append(
+                    f"{rel}:{node.lineno}: [missing-session-stamp] "
+                    f"{name}() stamps queue_wait_ms/cache_hit without "
+                    "session= — a serving-layer number without its "
+                    "tenant session is not attributable "
+                    "(serving/scheduler.py, docs/serving.md)")
         elif name == "dumps" and node.args and \
                 isinstance(node.args[0], ast.Dict):
             keys = {k.value for k in node.args[0].keys
