@@ -33,6 +33,17 @@ parity-checked device-tier cache hit scheduler-wide after recovery
 finish hit-less), and the same injected-chaos effectiveness floor as the
 legacy mode. Emits one JSONL row per session with the serving stamps
 (`session`, `queue_wait_ms`, `cache_hit` — lint_metrics-enforced).
+
+Fleet soak (`--workers N` with `--sessions`, docs/serving.md#fleet): the
+same chaos storm through `serving.FleetScheduler` — N executor workers
+behind the router, one worker KILLED mid-storm while it holds in-flight
+work. Asserts: zero failed sessions (every ticket resolves — queued work
+on the dead worker replays on survivors), bit-exact per-session parity
+vs solo for every completion, a bounded p99 queue wait, and >= 1
+parity-checked cache hit SERVED by a different worker than the one that
+COMPUTED it (the consistent-hash locality + promotion proof). Each
+session's JSONL row carries the `worker_id` stamp alongside the serving
+stamps (lint_metrics-enforced for fleet-path rows).
 """
 import os
 import sys
@@ -178,8 +189,185 @@ def soak_serving(args):
           "breaker recovered")
 
 
+def soak_fleet(args):
+    """`--workers N` mode: the chaos storm through the fleet tier with a
+    deliberate mid-storm worker kill (module docstring)."""
+    from spark_rapids_tpu import faultinj
+    from spark_rapids_tpu.plan import PlanExecutor
+    from spark_rapids_tpu.serving import FleetScheduler
+    from benchmarks.bench_nds_q3 import build_tables as q3_tables
+    from benchmarks.bench_nds_q5 import build_tables as q5_tables
+    from benchmarks.nds_plans import (kernels_of, q3_inputs, q3_plan,
+                                      q5_inputs, q5_plan)
+
+    n_sessions = max(8, args.sessions)
+    n_workers = max(2, args.workers)
+    n = max(2000, int(30_000 * args.scale))
+    sales, dates3, items = q3_tables(n, seed=7)
+    tabs, dates5 = q5_tables(n, seed=3)
+    plans = {"q5": (q5_plan(), q5_inputs(tabs, dates5)),
+             "q3": (q3_plan(), q3_inputs(sales, dates3, items))}
+
+    solo = PlanExecutor(mode="eager")
+    refs = {q: solo.execute(p, i).table.to_pydict()
+            for q, (p, i) in plans.items()}
+
+    inj = faultinj.install(CONFIG)
+    plans_per_session = 3
+    p99_bound_ms = 60_000.0
+    try:
+        with FleetScheduler(workers=n_workers) as fleet:
+            handles = [fleet.open_session(
+                f"tenant-{i}",
+                priority=("interactive" if i % 2 == 0 else "batch"),
+                weight=1.0 + (i % 3),
+                quota_bytes=1 << 50) for i in range(n_sessions)]
+            tickets = []
+            for i, h in enumerate(handles):
+                qs = ("q3", "q5", "q3") if i % 2 == 0 else \
+                    ("q5", "q3", "q5")
+                for q in qs[:plans_per_session]:
+                    plan, inputs = plans[q]
+                    tickets.append((h.id, q, h.submit(plan, inputs)))
+            # MID-STORM KILL: a worker currently holding in-flight work,
+            # never the last live one — its queued jobs must replay on
+            # the survivors with nobody's session failing
+            victim = next(
+                (tk.worker for _, _, tk in tickets
+                 if not tk.done() and tk.worker), None)
+            if victim is None:
+                raise SystemExit("fleet soak: no in-flight work to kill "
+                                 "under — storm too small to prove "
+                                 "failover")
+            replayed = fleet.kill_worker(victim)
+            per_session = {}
+            degraded = 0
+            for sid, q, tk in tickets:
+                res = tk.result(timeout=600)
+                if res.table.to_pydict() != refs[q]:
+                    raise SystemExit(
+                        f"fleet soak: parity MISS for {sid}/{q} on "
+                        f"{tk.worker} (degraded={res.degraded}, "
+                        f"cached={res.cached}, replays={tk.replays})")
+                degraded += int(res.degraded)
+                per_session.setdefault(sid, []).append((tk, res))
+            faults = inj.get_and_reset_injected()
+            if len(per_session) != n_sessions or any(
+                    len(v) != plans_per_session
+                    for v in per_session.values()):
+                raise SystemExit("fleet soak: a session lost completions "
+                                 "across the kill")
+            waits = {sid: max(tk.queue_wait_ms for tk, _ in v)
+                     for sid, v in per_session.items()}
+            p99 = max(waits.values())
+            if p99 > p99_bound_ms:
+                raise SystemExit(f"fleet soak: p99 queue wait {p99:.0f} "
+                                 f"ms exceeds the {p99_bound_ms:.0f} ms "
+                                 "bound — a session starved")
+            if faults == 0 or degraded == 0:
+                raise SystemExit(f"fleet soak ineffective: {faults} "
+                                 f"faults, {degraded} degraded")
+            # recovery + the cross-worker locality proof: stop injecting,
+            # reset every survivor's device, then stage a fresh q3 so
+            # its COMPUTING worker is not its ring home — a pin plan
+            # whose fingerprint homes on a DIFFERENT worker goes first,
+            # and session affinity carries the in-flight fresh q3 to the
+            # pin's worker. A fresh session's ring-routed submission
+            # then serves the promoted hit back at the q3 home.
+            faultinj.uninstall()
+            live = [w for w in fleet._workers.values() if w.alive]
+            for w in live:
+                w.health.reset_device()
+                # heartbeat probe closes the half-open breaker NOW: a
+                # not-closed breaker carries a routing pressure penalty
+                # that would divert the locality probe off its ring home
+                w.health.probe()
+            s3, d3, i3 = q3_tables(max(512, n // 4), seed=77)
+            fresh = (q3_plan(), q3_inputs(s3, d3, i3))
+            fresh_ref = solo.execute(*fresh).table.to_pydict()
+            home = fleet._ring.route(fresh[0].fingerprint)
+            import numpy as _np
+            from spark_rapids_tpu import Column, Table, dtypes
+            from spark_rapids_tpu.plan import PlanBuilder, col
+
+            def _pin_plan(thr):
+                b = PlanBuilder()
+                return (b.scan("t", schema=["k", "v"])
+                        .filter(col("v") > thr)
+                        .aggregate(["k"], [("v", "sum", "total")])
+                        .sort(["k"]).build())
+
+            pin_plan = next(p for p in (_pin_plan(t) for t in range(100))
+                            if fleet._ring.route(p.fingerprint) != home)
+            import jax.numpy as _jnp
+            rng = _np.random.default_rng(9)
+            pin_tab = Table(
+                [Column(dtype=dtypes.INT64, length=50_000,
+                        data=_jnp.asarray(rng.integers(
+                            0, hi, 50_000, dtype=_np.int64)))
+                 for hi in (50, 100)], names=["k", "v"])
+            h = fleet.open_session("diverter", quota_bytes=1 << 50)
+            pin_tk = h.submit(pin_plan, {"t": pin_tab})
+            tk = h.submit(*fresh)        # rides affinity off its home
+            res = tk.result(timeout=600)
+            pin_tk.result(timeout=600)
+            if res.table.to_pydict() != fresh_ref:
+                raise SystemExit("fleet soak: recovery parity MISS")
+            if res.cached or tk.worker == home:
+                # the affinity window closed before the fresh submit
+                # (pin finished first) and the entry sits AT home, where
+                # no cross-worker hit can prove anything: seed a second
+                # fresh dataset through a peer worker's own front door
+                s3b, d3b, i3b = q3_tables(max(512, n // 4), seed=78)
+                fresh = (q3_plan(), q3_inputs(s3b, d3b, i3b))
+                fresh_ref = solo.execute(*fresh).table.to_pydict()
+                peer = next(w for w in live if w.id != home)
+                peer.scheduler.open_session(
+                    "seed", quota_bytes=1 << 50).run(*fresh)
+            probe = fleet.open_session("prober", quota_bytes=1 << 50)
+            tk = probe.submit(*fresh)
+            hot = tk.result(timeout=600)
+            if not hot.cached or hot.table.to_pydict() != fresh_ref:
+                raise SystemExit("fleet soak: no parity-checked cache "
+                                 f"hit at the ring home (cached="
+                                 f"{hot.cached}, worker={tk.worker})")
+            if tk.worker == hot.worker or not hot.worker:
+                raise SystemExit(
+                    "fleet soak: the hit was not cross-worker (served "
+                    f"by {tk.worker}, computed by {hot.worker or '?'}) "
+                    "— consistent-hash locality unproven")
+            fm = fleet.metrics()
+            for sid in sorted(per_session):
+                tk_last, res_last = per_session[sid][-1]
+                emit_record(
+                    "chaos_soak_fleet",
+                    {"sessions": n_sessions, "workers": n_workers,
+                     "rows": n},
+                    waits[sid] or 1e-3, n,
+                    impl="serving_fleet", session=sid,
+                    worker_id=tk_last.worker,
+                    queue_wait_ms=waits[sid],
+                    cache_hit=any(r.cached for _, r in per_session[sid]),
+                    kernels=kernels_of(res_last),
+                    retries=sum(r.retries for _, r in per_session[sid]),
+                    degraded=any(r.degraded for _, r in per_session[sid]),
+                    faults_injected=faults,
+                    replays=sum(t.replays for t, _ in per_session[sid]))
+    finally:
+        faultinj.uninstall()
+    print(f"fleet soak OK: {n_sessions} sessions x {plans_per_session} "
+          f"plans over {n_workers} workers, killed {victim} mid-storm "
+          f"({replayed} jobs replayed, {fm['replayed_jobs']} total), "
+          f"{faults} faults, {degraded} degraded, cross-worker hit "
+          f"served by {tk.worker} for {hot.worker}'s computation, "
+          f"{fm['cache_promotions']} promotions, p99 queue wait "
+          f"{p99:.1f} ms")
+
+
 def main(argv=None):
     args = parse_args(argv)
+    if args.workers > 0:
+        return soak_fleet(args)
     if args.sessions > 0:
         return soak_serving(args)
     from spark_rapids_tpu import faultinj

@@ -35,6 +35,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                          "(benchmarks/chaos_soak.py: N concurrent tenant "
                          "sessions through serving/scheduler.py; 0 keeps "
                          "the legacy single-caller soak)")
+    ap.add_argument("--workers", type=int, default=0,
+                    help="fleet soak width (benchmarks/chaos_soak.py: "
+                         "route --sessions tenants across N executor "
+                         "workers via serving/fleet.py and kill one "
+                         "mid-storm; 0 keeps the single-worker soak)")
     ap.add_argument("--cpu", action="store_true",
                     help="pin the CPU backend (CI smoke; the TPU tunnel can "
                          "hang at init — env-var pinning is unreliable under "
@@ -132,6 +137,7 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
                 session: str = None,
                 queue_wait_ms: float = None,
                 cache_hit: bool = None,
+                worker_id: str = None,
                 **extra) -> Dict:
     """Build + print one bench JSONL record.
 
@@ -179,6 +185,10 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
     one, the same rule as the backend stamp). lint_metrics enforces that
     a record stamping `queue_wait_ms` or `cache_hit` stamps `session`
     too — a serving number without its tenant is not attributable.
+    `worker_id` names the fleet worker that executed (or, for a cache
+    hit, COMPUTED) the result (serving/fleet.py); the multi-worker soak
+    stamps it on every serving-path row, and lint_metrics enforces the
+    stamp the same way it enforces `session`.
 
     Optional optimizer fields (the plan-tier benches and the nightly
     optimizer-parity stage record these, see docs/optimizer.md):
@@ -230,6 +240,8 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
         rec["queue_wait_ms"] = round(queue_wait_ms, 3)
     if cache_hit is not None:
         rec["cache_hit"] = bool(cache_hit)
+    if worker_id is not None:
+        rec["worker_id"] = worker_id
     if retries is not None:
         rec["retries"] = retries
     if faults_injected is not None:

@@ -11,6 +11,9 @@ Runs NDS q5 and q72 through the full-plan SPMD distributed tier on a
   result (the transport layer may never change a result);
 - compression is REAL: on at least one exchange edge the wire bytes are
   < 0.8x the logical bytes, and no edge's wire ever exceeds its logical;
+- KEY narrowing is real too (ISSUE 16): at least one hash edge per
+  query compresses below logical AND stamps a `keyN:forB` codec note,
+  proving the 8 B key-word planes themselves shrank on the wire;
 - the certifier cross-check holds: every planned Exchange edge's wire
   bytes sit at or under its certified per-edge payload bound
   (`footprint.check_observed` — the PR 12 bounds became a runtime
@@ -24,6 +27,7 @@ Runs NDS q5 and q72 through the full-plan SPMD distributed tier on a
 Like distributed_parity.py this runs with the stats store scoped OFF so
 the static planner's broadcast+shuffle mix is what the edges exercise.
 """
+import re
 import sys
 
 sys.path.insert(0, ".")
@@ -88,6 +92,7 @@ def _main(argv=None):
     }
     best_ratio = 1.0
     total_overlap = 0.0
+    key_narrowed = 0
     for name, (plan, inputs) in cases.items():
         n_rows = sum(t.num_rows for t in inputs.values())
         with _forced(SPARK_RAPIDS_TPU_EXCHANGE_PACK="on",
@@ -107,6 +112,18 @@ def _main(argv=None):
         ratios = [m.exchange_bytes / m.exchange_bytes_logical
                   for m in edges if m.exchange_bytes_logical]
         best_ratio = min([best_ratio, *ratios])
+        # key-word narrowing (ISSUE 16 remainder of ISSUE 14): across
+        # the suite at least one standalone HASH edge must both
+        # compress below logical and stamp a `keyN:forB` codec note
+        # proving the key planes (not just the value planes) shrank on
+        # the wire. Fused aggregate exchanges ship int64 partials at
+        # wire == logical by design, so the check aggregates over both
+        # queries (q5's hash edges all fuse).
+        key_narrowed += sum(
+            1 for m in edges
+            if "hash" in m.exchange_how
+            and m.exchange_bytes < m.exchange_bytes_logical
+            and re.search(r"\bkey\d+:for\d+", m.exchange_codecs or ""))
         assert res.cert is not None, f"{name}: no resource cert stamped"
         bad = check_observed(res.cert, res)
         assert bad is None, f"{name}: certifier cross-check failed: {bad}"
@@ -141,8 +158,12 @@ def _main(argv=None):
          "pass-through everywhere")
     assert total_overlap > 0.0, \
         "async dispatch produced zero exchange/compute overlap"
+    assert key_narrowed > 0, \
+        ("no hash edge narrowed its key-word planes (keyN:forB) — the "
+         "ISSUE 16 key-narrowing path never fired")
     print(f"exchange transport OK (best wire/logical {best_ratio:.3f}, "
-          f"overlap {total_overlap:.1f} ms)", flush=True)
+          f"overlap {total_overlap:.1f} ms, "
+          f"{key_narrowed} key-narrowed hash edges)", flush=True)
 
 
 if __name__ == "__main__":

@@ -32,6 +32,15 @@ JAX_PLATFORMS=cpu python benchmarks/chaos_soak.py --scale 0.2 --cpu
 # session with the session/queue_wait_ms/cache_hit stamps
 # (lint_metrics-enforced)
 JAX_PLATFORMS=cpu python benchmarks/chaos_soak.py --scale 0.2 --cpu --sessions 8
+# fleet soak (docs/serving.md#fleet): the same chaos storm through
+# serving.FleetScheduler — 8 tenant sessions over 3 executor workers with
+# one worker KILLED mid-storm while holding in-flight work. Asserts zero
+# failed sessions (dead worker's queued jobs replay on survivors),
+# bit-exact per-session parity for every completion, a bounded p99 queue
+# wait, and >=1 parity-checked cache hit SERVED by a different worker
+# than the one that COMPUTED it (consistent-hash locality + promotion);
+# per-session JSONL rows carry the worker_id stamp (lint_metrics-enforced)
+JAX_PLATFORMS=cpu python benchmarks/chaos_soak.py --scale 0.2 --cpu --sessions 8 --workers 3
 # optimizer parity (docs/optimizer.md): the four NDS plans, capped tier,
 # optimizer off vs on — asserts result parity, nonzero pruned-column
 # counts on q5/q72, and a fingerprint-keyed jit-cache hit on a rebuilt

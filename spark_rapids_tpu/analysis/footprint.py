@@ -319,8 +319,11 @@ def _key_words(dt: Optional[dtypes.DType], nullable: bool) -> Optional[int]:
 def _hash_edge_row_bytes(node: Exchange, schema, ctypes,
                          cnull) -> Optional[int]:
     """Wire bytes per row of a standalone hash exchange: key columns as
-    8-byte order-preserving words (the partition-hash input, never
-    narrowed), every other column at most its unpacked width."""
+    8-byte order-preserving words, every other column at most its
+    unpacked width. The transport may FOR-narrow the shipped key planes
+    (transport.narrow_words) and widen them back for the partition hash
+    — a strict shrink, so pricing keys at full width stays a sound
+    upper bound."""
     total = 0
     keyset = set(node.keys)
     for k in node.keys:

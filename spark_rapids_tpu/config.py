@@ -48,6 +48,11 @@ equivalents, all read at use time (not import time) so tests can monkeypatch:
 | SPARK_RAPIDS_TPU_SERVING_CACHE_TTL_S | 300 | plan-result cache entry time-to-live (seconds) |
 | SPARK_RAPIDS_TPU_SERVING_OVER_QUOTA | reject | what a plan whose quota charge exceeds the session's remaining quota ceiling does: reject (typed ServingRejectedError naming session + operator, before compilation) / degrade (run on the CPU tier — the device quota does not bind there) |
 | SPARK_RAPIDS_TPU_SERVING_BACKPRESSURE | block | submit() behavior at a full queue: block (wait for space) / reject (fast ServingRejectedError); per-submit override wins |
+| SPARK_RAPIDS_TPU_SERVING_FEEDBACK | on | dispatch-fairness feedback loop (serving/scheduler.py): a session's WDRR credit grant scales down by its decayed cumulative wall-ms + retry cost, floored at a quarter of the configured weight; "off" restores pure weight-proportional credit |
+| SPARK_RAPIDS_TPU_SERVING_FEEDBACK_HALFLIFE_S | 300 | half-life of the feedback cost decay — one bad hour fades instead of starving a tenant forever; <=0 disables decay (cost only accumulates) |
+| SPARK_RAPIDS_TPU_FLEET_WORKERS | 1 | fleet serving tier (serving/fleet.py, docs/serving.md#fleet): executor workers behind the router; 1 (default) keeps the single-worker ServingScheduler path byte-identical |
+| SPARK_RAPIDS_TPU_FLEET_RING_REPLICAS | 64 | consistent-hash ring virtual nodes per worker — higher spreads fingerprints more evenly at slightly more route cost |
+| SPARK_RAPIDS_TPU_FLEET_SPILL_RATIO | 2.0 | load-aware spillover threshold: the routed worker sheds to the least-pressured replica when its pressure score exceeds ratio x (best score + 1); <=0 disables spillover |
 
 The SPARK_RAPIDS_TPU_BREAKER_* numeric knobs are snapshotted when a
 `DeviceHealthMonitor` is constructed (one policy per monitor lifetime —
@@ -437,6 +442,56 @@ def serving_backpressure() -> str:
             f"SPARK_RAPIDS_TPU_SERVING_BACKPRESSURE={v!r}: expected block "
             "or reject")
     return v
+
+
+def serving_feedback() -> bool:
+    """Dispatch-fairness feedback loop (serving/scheduler.py,
+    docs/serving.md#fairness): when on, a session's WDRR credit grant
+    scales down by its decayed cumulative wall-ms + retry cost — heavy
+    recent consumers earn dispatch credit slower, bounded (floored at a
+    quarter of the configured weight) so feedback skews but never
+    starves. "off" restores pure weight-proportional credit. Same
+    strict-typo policy as the kernel selectors."""
+    v = os.environ.get("SPARK_RAPIDS_TPU_SERVING_FEEDBACK", "on")
+    if v not in ("on", "off"):
+        raise ValueError(
+            f"SPARK_RAPIDS_TPU_SERVING_FEEDBACK={v!r}: expected on or off")
+    return v == "on"
+
+
+def serving_feedback_halflife_s() -> float:
+    """Half-life (seconds) of the feedback cost decay: a session's
+    accumulated wall/retry cost halves every this-many seconds of wall
+    time, so one bad hour fades instead of permanently down-weighting
+    the tenant. <=0 disables decay (cost only accumulates)."""
+    return _float_env("SPARK_RAPIDS_TPU_SERVING_FEEDBACK_HALFLIFE_S",
+                      300.0)
+
+
+def fleet_workers() -> int:
+    """Fleet serving tier (serving/fleet.py, docs/serving.md#fleet):
+    executor workers the router fronts, each owning its own
+    PlanExecutor + health monitor + stats store + result cache. The
+    default 1 keeps serving on the single-worker ServingScheduler path
+    (byte-identical to a fleet-less build)."""
+    return max(1, _int_env("SPARK_RAPIDS_TPU_FLEET_WORKERS", 1))
+
+
+def fleet_ring_replicas() -> int:
+    """Consistent-hash ring virtual nodes per fleet worker
+    (serving/router.py): more replicas spread plan fingerprints more
+    evenly across workers and shrink the key range that moves on
+    join/leave, at slightly higher route cost."""
+    return max(1, _int_env("SPARK_RAPIDS_TPU_FLEET_RING_REPLICAS", 64))
+
+
+def fleet_spill_ratio() -> float:
+    """Load-aware spillover threshold (serving/fleet.py): the
+    consistent-hash-routed worker sheds a new session to the
+    least-pressured worker when its pressure score exceeds
+    ratio x (best score + 1). Higher values prefer cache locality over
+    load balance; <=0 disables spillover entirely."""
+    return _float_env("SPARK_RAPIDS_TPU_FLEET_SPILL_RATIO", 2.0)
 
 
 def faultinj_config_path() -> str:

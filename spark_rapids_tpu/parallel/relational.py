@@ -319,7 +319,8 @@ def distributed_repartition_keyed(mesh: Mesh,
                                   key_words: Sequence[jnp.ndarray],
                                   key_specs, vals: Sequence[jnp.ndarray],
                                   slack: float = 2.0, axis: str = "data",
-                                  alive=None):
+                                  alive=None, word_codecs=None,
+                                  word_refs=None):
     """Standalone hash-partition exchange of one relation — the physical
     form of an `Exchange(hash)` plan node: every row moves to the shard
     given by the Spark-exact hash of its key words (pmod n_peers), so a
@@ -327,9 +328,20 @@ def distributed_repartition_keyed(mesh: Mesh,
     groupby) can run with no further collective. `alive` marks live rows
     of a padded sharded relation; dead rows are dropped by the bucketing.
 
-    Returns ([key words], [vals], valid, overflow); overflow means a
-    bucket spilled its slack-sized capacity — retry with bigger slack
-    (SplitAndRetry contract)."""
+    `word_codecs`/`word_refs` carry the narrowed-key wire form
+    (plan/transport.narrow_words): `word_codecs` is a static per-word
+    codec tuple ("raw" | "forN") and `word_refs` the traced (1,) int64
+    reference arrays, one per non-raw word in order. Narrowed planes are
+    widened back to their exact 64-bit words INSIDE the collective body
+    for the Spark-exact hash — placement is bit-identical to the raw
+    path — while the all-to-all ships the narrow planes. References ride
+    as traced arrays (replicated specs), not baked constants, so one
+    compiled program serves every execution of the same layout.
+
+    Returns ([key words], [vals], valid, overflow); the key words come
+    back in the wire form they were passed (the caller widens). overflow
+    means a bucket spilled its slack-sized capacity — retry with bigger
+    slack (SplitAndRetry contract)."""
     from .keys import spark_partition_hash
     n_peers = mesh.shape[axis]
     hash_fn = lambda ws: spark_partition_hash(ws, key_specs)  # noqa: E731
@@ -337,20 +349,33 @@ def distributed_repartition_keyed(mesh: Mesh,
     vals = list(vals)
     nk, nv = len(key_words), len(vals)
     has_alive = alive is not None
+    codecs_t = tuple(word_codecs) if word_codecs else ("raw",) * nk
+    refs = list(word_refs or [])
+    narrowed = any(c != "raw" for c in codecs_t)
 
     def local(*arrs):
         ws, vs = list(arrs[:nk]), list(arrs[nk:nk + nv])
-        live = arrs[-1] if has_alive else None
-        Ws, Vs, recv_alive, spilled = _hash_exchange(
-            axis, n_peers, slack, ws, vs, hash_fn, alive=live)
+        live = arrs[nk + nv] if has_alive else None
+        if narrowed:
+            rs = iter(arrs[nk + nv + int(has_alive):])
+            ws64 = [w if c == "raw" else next(rs)[0] + w.astype(jnp.int64)
+                    for w, c in zip(ws, codecs_t)]
+            fills = [_DEAD_KEY if c == "raw" else 0 for c in codecs_t]
+            Ws, Vs, recv_alive, spilled = _hash_exchange(
+                axis, n_peers, slack, ws, vs, hash_fn, alive=live,
+                hash_keys=ws64, key_fills=fills)
+        else:
+            Ws, Vs, recv_alive, spilled = _hash_exchange(
+                axis, n_peers, slack, ws, vs, hash_fn, alive=live)
         return (tuple(Ws), tuple(Vs), recv_alive, spilled.reshape(1))
 
     spec = P(axis)
     fn = shard_map(local, mesh=mesh,
-                   in_specs=(spec,) * (nk + nv + int(has_alive)),
+                   in_specs=(spec,) * (nk + nv + int(has_alive))
+                   + (P(),) * len(refs),
                    out_specs=(tuple(spec for _ in key_words),
                               tuple(spec for _ in vals), spec, spec))
-    args = key_words + vals + ([alive] if has_alive else [])
+    args = key_words + vals + ([alive] if has_alive else []) + refs
     return fn(*args)
 
 
@@ -605,7 +630,8 @@ def _local_join_tail(lk, lv, lalive, rk, rv, ralive, row_cap: int,
 
 
 def _hash_exchange(axis: str, n_peers: int, slack: float,
-                   keys, vals, hash_fn=None, alive=None):
+                   keys, vals, hash_fn=None, alive=None,
+                   hash_keys=None, key_fills=None):
     """Hash-partition by Spark murmur pmod and all-to-all one table side
     (the shared shuffle wiring of every distributed join). `keys` may be a
     single int64 array or a word list (typed keys); `vals` may be None
@@ -613,15 +639,26 @@ def _hash_exchange(axis: str, n_peers: int, slack: float,
     `alive` (optional (n,) bool) marks live rows: dead rows route to the
     out-of-range partition id `n_peers` and are silently dropped by the
     bucketing — the padded-relation contract of the plan tier's sharded
-    relations. Returns (key outs, val outs, alive, spilled)."""
+    relations. `hash_keys` (default: `keys`) is the array list the hash
+    runs over — the narrowed-key exchange ships narrow planes but hashes
+    their widened 64-bit word form (plan/transport.narrow_words), so the
+    wire and the hash input may legitimately differ. `key_fills` gives
+    each key plane's dead-slot fill (default `_DEAD_KEY`; narrowed
+    planes fill 0 — int64.max would wrap in a narrow dtype, and dead
+    slots are never read anyway). Returns (key outs, val outs, alive,
+    spilled)."""
     key_list = _as_list(keys)
     val_list = [] if vals is None else _as_list(vals)
     nloc = key_list[0].shape[0]
     cap = max(1, math.ceil(nloc / n_peers * slack))
-    part = partition_ids((hash_fn or _spark_murmur_i64)(key_list), n_peers)
+    hash_list = key_list if hash_keys is None else _as_list(hash_keys)
+    part = partition_ids((hash_fn or _spark_murmur_i64)(hash_list), n_peers)
     if alive is not None:
         part = jnp.where(alive, part, jnp.int32(n_peers))
-    payloads = [(k, _DEAD_KEY) for k in key_list] + [(v, 0) for v in val_list]
+    fills = ([_DEAD_KEY] * len(key_list) if key_fills is None
+             else list(key_fills))
+    payloads = [(k, f) for k, f in zip(key_list, fills)] \
+        + [(v, 0) for v in val_list]
     outs, alive, spilled = _bucket_exchange(axis, n_peers, cap, part, payloads)
     # a spill anywhere means some shard RECEIVED an incomplete side: agree on
     # the flag across the mesh (same contract as distributed_sort) so the

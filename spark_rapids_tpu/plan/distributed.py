@@ -883,8 +883,11 @@ class DistContext:
         """Hash-exchange a sharded relation by `keys`; returns
         (repartitioned rel, logical payload bytes, wire bytes, codec
         string). Key columns ride their 64-bit order-preserving word
-        encoding (8 B x total_words each — the hash input, never
-        narrowed); value columns ship packed."""
+        encoding — logically 8 B x total_words each; with packing on
+        the shipped planes FOR-narrow (transport.narrow_words) and the
+        collective body widens them back for the Spark-exact hash, so
+        placement stays bit-identical while the wire shrinks. Value
+        columns ship packed."""
         from ..parallel.relational import distributed_repartition_keyed
         specs = _key_specs(c.table, keys)
         if specs is None or not table_shardable(c.table):
@@ -895,13 +898,26 @@ class DistContext:
         live = c.num_rows
         key_word_bytes = 8 * sum(sp.total_words for sp in specs)
         logical_row = key_word_bytes + transport.logical_row_bytes(val_cols)
-        dp = layout = None
+        dp = layout = wplans = None
+        word_codecs, refs = (), []
         if self.pack:
             dp = transport.pack_device(val_cols, vnames, c.valid,
                                        self.codecs)
             vals = dp.planes
-            wire_row = key_word_bytes + dp.wire_row_bytes
             codec = dp.codec_str
+            key_wire_bytes = key_word_bytes
+            if "for" in self.codecs:
+                words, wplans, key_wire_bytes, knote = \
+                    transport.narrow_words(words, c.valid)
+                if knote:
+                    codec = ",".join(x for x in (codec, knote) if x)
+                word_codecs = tuple(p.codec for p in wplans)
+                # references ride as traced (1,) arrays so the compiled
+                # program is reusable across executions (and the jit
+                # cache keys on the static codec layout, not the data)
+                refs = [jnp.full((1,), p.ref, jnp.int64)
+                        for p in wplans if p.codec != "raw"]
+            wire_row = key_wire_bytes + dp.wire_row_bytes
         else:
             vals, layout = _pack_cols(c.table, vnames)
             wire_row, codec = logical_row, ""
@@ -913,17 +929,22 @@ class DistContext:
         mesh, axis = self.mesh, self.axis
 
         def run(slack):
-            key = ("repart", mesh, axis, tuple(specs), nw, nv, slack)
+            key = ("repart", mesh, axis, tuple(specs), nw, nv, slack,
+                   word_codecs)
             fn = _jitted(key, lambda: jax.jit(
                 lambda *arrs: distributed_repartition_keyed(
                     mesh, list(arrs[:nw]), specs,
-                    list(arrs[nw:-1]), slack=slack, axis=axis,
-                    alive=arrs[-1])))
-            return fn(*words, *vals, c.valid)
+                    list(arrs[nw:nw + nv]), slack=slack, axis=axis,
+                    alive=arrs[nw + nv],
+                    word_codecs=word_codecs or None,
+                    word_refs=list(arrs[nw + nv + 1:]) or None)))
+            return fn(*words, *vals, c.valid, *refs)
 
         ws, vs, alive, _ = self._retry(
             node, tag, run, self._caps(node, tag, {"slack": self.slack}), m)
         alive = alive.astype(jnp.bool_)
+        if wplans is not None:
+            ws = transport.widen_words(list(ws), wplans)
         cols = dict(_decode_keys(ws, specs, keys, alive))
         if dp is not None:
             unpacked = transport.unpack_device(list(vs), dp)

@@ -363,7 +363,8 @@ class PlanResult:
         self.caps = caps              # final (possibly grown) capacities
         self.retries = retries        # plan-level recoverable-fault re-runs
         self.degraded = degraded      # finished on the CPU tier (breaker trip)
-        self.breaker = breaker        # {"state","trips","reason","error"}
+        self.breaker = breaker        # {"state","trips","reason","error"
+        #                               [,"worker_id" in a fleet]}
         self.backoff_ms = backoff_ms  # total retry backoff across the plan
         self.jit_cache_hits = jit_cache_hits  # capped-tier fingerprint-keyed
         #                               compiled-program reuses this execute
@@ -376,6 +377,13 @@ class PlanResult:
         #                               .md): set by execute() from the
         #                               active sessionctx scope, "" outside
         #                               the serving layer
+        self.worker = ""              # fleet worker stamp (serving/fleet
+        #                               .py): the executor's worker_id, ""
+        #                               outside a fleet — on a cache-hit
+        #                               COPY it names the worker that
+        #                               COMPUTED the entry, which is how
+        #                               the soak proves cross-worker
+        #                               cache locality
         self.cached = False           # served from the serving result cache
         #                               (serving/cache.py): True ONLY on a
         #                               cache-hit COPY — its metrics are
@@ -429,7 +437,8 @@ class PlanExecutor:
                  health=None,
                  degrade: Optional[str] = None,
                  optimize: Optional[bool] = None,
-                 cert_budget: Optional[int] = None):
+                 cert_budget: Optional[int] = None,
+                 worker_id: str = ""):
         if mode not in ("eager", "capped"):
             raise ValueError(f"unknown executor mode {mode!r}")
         # mesh + capped is checked PER PLAN in execute(): only a plan that
@@ -444,6 +453,11 @@ class PlanExecutor:
         self.mesh = mesh
         self.mesh_axis = mesh_axis
         self.session = session
+        # fleet worker identity (serving/fleet.py): stamped on every
+        # result and per-op metric this executor produces, "" outside a
+        # fleet — failure attribution and the soak's cross-worker
+        # cache-locality proof both need to know WHICH worker ran a plan
+        self.worker_id = str(worker_id)
         self.block_per_op = block_per_op
         # health: the degradation policy owner (runtime/health.py). Pass a
         # shared monitor to give several executors one breaker per device.
@@ -586,6 +600,10 @@ class PlanExecutor:
             res.session = sid
             for mm in res.metrics.values():
                 mm.session = sid
+        if self.worker_id:
+            res.worker = self.worker_id
+            for mm in res.metrics.values():
+                mm.worker_id = self.worker_id
         if report is not None:
             res.optimizer = report.to_dict()
         from . import stats as stats_mod
@@ -846,8 +864,12 @@ class PlanExecutor:
     # ---- health / degradation policy --------------------------------------
     def _breaker_snapshot(self) -> Dict:
         br = self.health.breaker
-        return {"state": br.state, "trips": br.trips,
+        snap = {"state": br.state, "trips": br.trips,
                 "reason": br.last_trip_reason, "error": br.last_trip_error}
+        wid = getattr(self.health, "worker_id", "")
+        if wid:
+            snap["worker_id"] = wid
+        return snap
 
     def _handle_fault(self, err, op_label: str, attempt: int,
                       metric: OperatorMetrics) -> bool:

@@ -36,6 +36,10 @@ class OperatorMetrics:
     # layer — per-tenant accounting must never be inferred from thread
     # identity (dispatcher workers are multiplexed across sessions)
     session: str = ""
+    # fleet worker stamp (serving/fleet.py): which executor worker ran
+    # this operator, "" outside a fleet — multi-worker soaks attribute
+    # per-op numbers to the worker that produced them
+    worker_id: str = ""
     # kernel-registry choice for operators with registered alternatives
     # (ops/registry.py, docs/kernels.md): "pallas:fused_select",
     # "scan:groupby", "xla:topk", ... — trajectory numbers must never

@@ -81,7 +81,9 @@ class StatsStore:
     """Bounded feedback store. All tables key on backend first:
 
     - plans:    (backend, source fingerprint) -> {executed_fp, runs,
-                caps{cap key: high-water}, ops{toposort idx: row}}
+                caps{cap key: high-water}, peak_bytes (high-water
+                observed live bytes — serving admission's warm charge),
+                ops{toposort idx: row}}
     - subtrees: (backend, subtree fingerprint) -> {rows (high-water),
                 runs} — observed output cardinality of that exact
                 operator subtree, the optimizer's estimate override
@@ -152,16 +154,31 @@ class StatsStore:
         from .optimizer import subtree_fingerprints
         source_fp = source_fp or plan.fingerprint
         sub = subtree_fingerprints(plan.root)
+        # observed peak live bytes: the widest node-plus-inputs frontier
+        # the walk actually materialized — the serving layer's admission
+        # charge for WARM fingerprints (ISSUE 16: certified cross-product
+        # bounds overcharge; what the plan DID is the better sizer)
+        peak = 0
+        for node in plan.nodes:
+            m = result.metrics.get(node.label)
+            if m is None:
+                continue
+            tot = int(m.bytes_out) + sum(
+                int(result.metrics[c.label].bytes_out)
+                for c in node.children if c.label in result.metrics)
+            peak = max(peak, tot)
         event = {"backend": backend, "source_fp": source_fp,
                  "executed_fp": plan.fingerprint, "caps": {},
+                 "peak_bytes": peak,
                  "ops": {}, "subtrees": {}, "io": {}, "kernels": []}
         with self._lock:
             key = (backend, source_fp)
             ps = self._plans.get(key) or {
                 "executed_fp": plan.fingerprint, "runs": 0, "caps": {},
-                "ops": {}}
+                "peak_bytes": 0, "ops": {}}
             ps["runs"] += 1
             ps["executed_fp"] = plan.fingerprint
+            ps["peak_bytes"] = max(int(ps.get("peak_bytes", 0)), peak)
             if (result.caps and result.mode == "capped"
                     and not result.degraded):
                 # final (possibly escalated) capacities: high-water.
@@ -295,6 +312,36 @@ class StatsStore:
             ps = self._plans.get((backend, source_fp))
             return 0 if ps is None else int(ps["runs"])
 
+    def observed_peak_bytes(self, backend: str, source_fp: str
+                            ) -> Optional[Tuple[int, int]]:
+        """(high-water observed live bytes, run count) for this authored
+        plan on this backend — the serving layer's warm-fingerprint
+        admission charge (docs/serving.md#admission). None when the plan
+        was never seen here or no run produced byte counts (admission
+        falls back to the certified bound, then the flat default)."""
+        with self._lock:
+            ps = self._plans.get((backend, source_fp))
+            if ps is None or not ps.get("peak_bytes"):
+                return None
+            self.hits += 1
+            return int(ps["peak_bytes"]), int(ps["runs"])
+
+    def forget_plan(self, source_fp: str) -> int:
+        """Drop every backend's entry for this authored plan (the fleet
+        invalidation bus: a source input's digest changed, so observed
+        sizes may describe data that no longer exists). Subtree/io/kernel
+        tables survive — they key on structural fingerprints that remain
+        valid observations of whatever data they saw. Returns the number
+        of entries dropped."""
+        with self._lock:
+            doomed = [k for k in list(self._plans.keys())
+                      if k[1] == source_fp]
+            for k in doomed:
+                del self._plans[k]
+            if doomed:
+                self.generation += 1
+            return len(doomed)
+
     def op_stats(self, backend: str, source_fp: str) -> Dict[int, Dict]:
         """toposort index -> {rows_out, bytes_out, wall_ms, kernel} of
         the last recorded execution of this authored plan on `backend`.
@@ -372,9 +419,11 @@ class StatsStore:
                 key = (backend, ev["source_fp"])
                 ps = self._plans.get(key) or {
                     "executed_fp": ev["executed_fp"], "runs": 0,
-                    "caps": {}, "ops": {}}
+                    "caps": {}, "peak_bytes": 0, "ops": {}}
                 ps["runs"] += 1
                 ps["executed_fp"] = ev["executed_fp"]
+                ps["peak_bytes"] = max(int(ps.get("peak_bytes", 0)),
+                                       int(ev.get("peak_bytes") or 0))
                 for k, v in (ev.get("caps") or {}).items():
                     ps["caps"][k] = max(int(ps["caps"].get(k, 0)), int(v))
                 for i, v in (ev.get("ops") or {}).items():

@@ -58,8 +58,9 @@ from ..columnar import Column
 
 ALL_CODECS = frozenset({"for", "dict", "rle", "bitpack"})
 
-__all__ = ["ALL_CODECS", "DevicePack", "HostPacked", "logical_col_bytes",
-           "logical_row_bytes", "pack_device", "unpack_device",
+__all__ = ["ALL_CODECS", "DevicePack", "HostPacked", "WordPlan",
+           "logical_col_bytes", "logical_row_bytes", "narrow_words",
+           "widen_words", "pack_device", "unpack_device",
            "unpack_device_np", "pack_host", "unpack_host",
            "unpack_host_device", "pack_bits_device", "unpack_bits_np"]
 
@@ -239,6 +240,82 @@ def unpack_device_np(arrays: Sequence[np.ndarray], pack: DevicePack
                 .astype(bool)
         out.append((data, validity))
     return out
+
+
+# ---- key-word narrowing (hash-exchange edges) -------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class WordPlan:
+    """Static decode recipe for one key-word plane of a hash exchange
+    (the 64-bit order-preserving words of parallel/keys.py). `codec` is
+    "raw" (the plane ships as its int64 word) or "forN" (it ships as
+    `word - ref` in the narrow unsigned width); `ref` is an exact
+    Python int. `nbytes` is the plane's wire bytes per row."""
+    codec: str
+    ref: int
+    nbytes: int
+
+
+def narrow_words(words: Sequence, live
+                 ) -> Tuple[List, Tuple[WordPlan, ...], int, str]:
+    """FOR-narrow the int64 key-word planes a hash exchange ships.
+
+    Key columns used to ride hash edges at a flat 8 B per word (the
+    "never narrowed" remainder of the packed wire format): the words are
+    the HASH input, and the Spark-exact murmur must see them at full
+    width inside the collective body. Narrowing is still sound because
+    the hash input and the wire form need not be the same arrays — the
+    exchange widens each narrowed plane back to its exact word
+    (`ref + narrow.astype(int64)`) for the hash, then ships the narrow
+    plane (parallel/relational.distributed_repartition_keyed). Placement
+    is bit-identical; only the wire narrows.
+
+    Same inspection discipline as `_for_probe`: one masked min/max
+    reduce per plane over the LIVE rows — eager reduces over sharded
+    arrays are global, so every shard derives the same reference — with
+    exact reconstruction for every live slot (null-key rows' data words
+    are zeroed at encode time, so they sit inside the probed range).
+    Dead slots ship wrapped garbage no consumer reads (decode zeroes
+    them under the alive mask). Null-flag words (0/1) narrow to one
+    byte for free. The certifier keeps pricing key words at 8 B each
+    (analysis/footprint.py) — a sound hi-bound the narrowed wire only
+    ever undershoots.
+
+    Returns (planes, plans, wire_bytes_per_row, codec_note); an all-raw
+    outcome returns the input planes and an empty note."""
+    planes: List = []
+    plans: List[WordPlan] = []
+    notes: List[str] = []
+    wire = 0
+    info = jnp.iinfo(jnp.int64)
+    for i, w in enumerate(words):
+        plan = WordPlan("raw", 0, 8)
+        plane = w
+        if w.shape[0]:
+            lo = int(jnp.min(jnp.where(live, w, info.max)))
+            hi = int(jnp.max(jnp.where(live, w, info.min)))
+            if lo <= hi:                # any live rows at all
+                span = hi - lo          # exact (host ints)
+                for bits, tgt in _FOR_TARGETS:
+                    if span < (1 << bits):
+                        plane = (w - jnp.int64(lo)).astype(tgt)
+                        plan = WordPlan(f"for{bits}", lo, bits // 8)
+                        notes.append(f"key{i}:for{bits}")
+                        break
+        planes.append(plane)
+        plans.append(plan)
+        wire += plan.nbytes
+    return planes, tuple(plans), wire, ",".join(notes)
+
+
+def widen_words(planes: Sequence, plans: Sequence[WordPlan]) -> List:
+    """Inverse of `narrow_words` for RECEIVED planes (outside the
+    collective): each narrowed plane back to its exact int64 word array.
+    Dead slots widen to garbage no consumer reads — the relation's
+    alive mask owns liveness, and key decode zeroes dead words."""
+    return [p if wp.codec == "raw"
+            else (jnp.int64(wp.ref) + p.astype(jnp.int64))
+            for p, wp in zip(planes, plans)]
 
 
 def pack_bits_device(mask) -> Tuple[object, int]:

@@ -188,8 +188,13 @@ class DeviceHealthMonitor:
                  probe: Optional[Callable[[], bool]] = None,
                  sleep: Callable[[float], None] = time.sleep,
                  clock: Callable[[], float] = time.monotonic,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 worker_id: str = ""):
         from .. import config
+        # fleet worker identity (serving/fleet.py): one monitor guards
+        # one worker's device, so breaker snapshots carry WHOSE breaker
+        # tripped — "" outside a fleet
+        self.worker_id = str(worker_id)
         self.retry_budget = (config.breaker_retry_budget()
                              if retry_budget is None else retry_budget)
         self.backoff_base_ms = (config.breaker_backoff_base_ms()

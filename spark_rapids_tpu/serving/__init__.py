@@ -13,11 +13,26 @@ data-digest result cache.
     with ServingScheduler() as sched:
         tenant = sched.open_session(priority="interactive")
         res = tenant.run(plan, {"t": table})
+
+`FleetScheduler` scales that out: a router tier fronting N such workers
+— consistent-hash plan routing (serving/router.py), session affinity,
+load spillover, failover replay when a worker dies, and a cross-worker
+cache-invalidation bus (serving/fleet.py).
+
+    from spark_rapids_tpu.serving import FleetScheduler
+
+    with FleetScheduler(workers=4) as fleet:
+        tenant = fleet.open_session(priority="interactive")
+        res = tenant.run(plan, {"t": table})
 """
 from .cache import ResultCache, cache_key, cached_copy, input_digest
+from .fleet import FleetScheduler, FleetSession, FleetTicket, FleetWorker
+from .router import HashRing
 from .scheduler import (PRIORITIES, ServingRejectedError, ServingScheduler,
                         ServingSession, Ticket)
 
 __all__ = ["ServingScheduler", "ServingSession", "Ticket",
            "ServingRejectedError", "ResultCache", "cache_key",
-           "cached_copy", "input_digest", "PRIORITIES"]
+           "cached_copy", "input_digest", "PRIORITIES",
+           "FleetScheduler", "FleetSession", "FleetTicket", "FleetWorker",
+           "HashRing"]

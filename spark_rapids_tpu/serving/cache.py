@@ -167,6 +167,10 @@ def cached_copy(result):
                       else result.optimizer)
     copy.cert = result.cert           # immutable bounds, shared by design
     copy.session = result.session
+    # the worker stamp survives the copy ON PURPOSE: a hit names the
+    # worker that COMPUTED the entry, not the one serving it — the
+    # fleet soak's cross-worker cache-locality proof reads exactly this
+    copy.worker = result.worker
     copy.cached = True
     return copy
 
@@ -255,16 +259,73 @@ class ResultCache:
         # put time
         entry = cached_copy(result)
         with self._lock:
-            old = self._data.pop(key, None)
-            if old is not None:
-                self._resident_bytes -= old[1]
-            self._data[key] = (self._clock(), nbytes, entry)
-            self._resident_bytes += nbytes
-            while len(self._data) > self.entries or \
-                    self._resident_bytes > self.max_bytes:
-                _, ev_bytes, _ = self._data.pop(next(iter(self._data)))
-                self._resident_bytes -= ev_bytes
+            self._insert_locked(key, nbytes, entry)
+
+    def _insert_locked(self, key, nbytes: int, entry) -> None:
+        old = self._data.pop(key, None)
+        if old is not None:
+            self._resident_bytes -= old[1]
+        self._data[key] = (self._clock(), nbytes, entry)
+        self._resident_bytes += nbytes
+        while len(self._data) > self.entries or \
+                self._resident_bytes > self.max_bytes:
+            _, ev_bytes, _ = self._data.pop(next(iter(self._data)))
+            self._resident_bytes -= ev_bytes
+            self.evictions += 1
+
+    def peek_frozen(self, key: Optional[Tuple[str, str]]):
+        """The frozen entry as `(nbytes, result)` for cross-worker
+        promotion (serving/fleet.py), or None. TTL-honored, but NO
+        hit/miss accounting and no recency refresh — promotion is
+        router plumbing, not tenant traffic, and must not skew the
+        stats either cache reports."""
+        if key is None or self.entries <= 0:
+            return None
+        with self._lock:
+            ent = self._data.get(key)
+            if ent is None:
+                return None
+            stored_at, nbytes, result = ent
+            if self.ttl_s > 0 and self._clock() - stored_at > self.ttl_s:
+                del self._data[key]
+                self._resident_bytes -= nbytes
+                self.expirations += 1
+                return None
+            return (nbytes, result)
+
+    def adopt(self, key: Optional[Tuple[str, str]], nbytes: int,
+              entry) -> None:
+        """Insert an already-frozen entry promoted from a peer worker's
+        cache. The frozen object is SHARED between the caches on
+        purpose: entries are immutable by contract and every serve
+        copies, so adoption costs a dict slot, not a table copy — and
+        the entry keeps its original `worker` stamp, which is how a hit
+        served here still names the worker that computed it."""
+        if key is None or self.entries <= 0 or entry is None:
+            return
+        if nbytes > self.max_bytes:
+            with self._lock:
+                self.oversize_skips += 1
+            return
+        with self._lock:
+            self._insert_locked(key, nbytes, entry)
+
+    def invalidate_fingerprint(self, fingerprint: str,
+                               keep_digest: Optional[str] = None) -> int:
+        """Drop every entry for this plan fingerprint whose input digest
+        differs from `keep_digest` (the fleet invalidation bus,
+        serving/fleet.py: a source input changed, so results computed
+        over the OLD data must stop serving everywhere — the entry for
+        the new digest, if any, is still sound and survives). Returns
+        the number of entries dropped; they count as evictions."""
+        with self._lock:
+            doomed = [k for k in self._data
+                      if k[0] == fingerprint and k[1] != keep_digest]
+            for k in doomed:
+                _, nbytes, _ = self._data.pop(k)
+                self._resident_bytes -= nbytes
                 self.evictions += 1
+            return len(doomed)
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

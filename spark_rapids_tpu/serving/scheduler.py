@@ -16,11 +16,20 @@ across tenants while every per-tenant bound stays per-tenant:
   per dispatched plan scaled by the session weight; an AGING bound
   (`SPARK_RAPIDS_TPU_SERVING_STARVATION_MS`) dispatches any plan that
   has waited too long regardless of lane or deficit, so weighted
-  fairness can skew throughput but never unbound a session's queue wait;
-- **quota admission** — every submission is charged
-  `footprint.quota_charge(cert, default)` bytes against its session's
-  device-memory quota: the PR 12 certifier's sound `peak_bytes_hi` when
-  the plan is bounded, a flat configurable default when it is not. A
+  fairness can skew throughput but never unbound a session's queue wait.
+  With `SPARK_RAPIDS_TPU_SERVING_FEEDBACK` on, each session's credit
+  grant scales down by its decayed cumulative wall-ms + retry cost (the
+  ROADMAP dispatch-fairness feedback loop) — half-life
+  `_FEEDBACK_HALFLIFE_S`, floored at a quarter of the configured weight
+  so one bad hour skews dispatch but can never starve a tenant;
+- **quota admission** — every submission is charged against its
+  session's device-memory quota: the OBSERVED high-water live bytes
+  when the stats store has seen this fingerprint on this backend (what
+  the plan DID — capped by the certified bound when both exist), else
+  `footprint.quota_charge(cert, default)`: the PR 12 certifier's sound
+  `peak_bytes_hi` when the plan is bounded, a flat configurable default
+  when it is not. The winning source ("observed"/"certified"/"default")
+  is stamped on the ticket (`charge_source`) and the soak's JSONL. A
   charge that can NEVER fit the session quota rejects (typed, naming
   session + the operator that set the certified peak, before any
   compilation) or pins the plan to the CPU tier, per
@@ -91,6 +100,8 @@ class Ticket:
         self.session = session_id
         self.queue_wait_ms: float = 0.0
         self.cached = False
+        self.charge_source = ""   # "observed" | "certified" | "default"
+        self.worker = ""          # fleet worker id ("" single-worker)
         self._event = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
@@ -126,6 +137,12 @@ class _SessionState:
         self.quota_bytes = quota_bytes
         self.deficit = 0.0
         self.in_flight_bytes = 0
+        # dispatch-fairness feedback (ISSUE 16): decayed cumulative cost
+        # (wall-ms + retry penalty) this session has charged the device;
+        # scales the WDRR credit grant down, bounded so one bad hour
+        # can never starve a tenant forever
+        self.cost_score = 0.0
+        self.cost_at = 0.0        # clock of the last decay application
         self.queue: Deque["_Job"] = collections.deque()
         # accounting for metrics()/the soak's per-session assertions
         self.submitted = 0
@@ -230,11 +247,22 @@ class ServingScheduler:
                  default_charge_bytes: Optional[int] = None,
                  over_quota: Optional[str] = None,
                  backpressure: Optional[str] = None,
+                 feedback: Optional[bool] = None,
+                 feedback_halflife_s: Optional[float] = None,
+                 stats_store=None,
                  clock=time.monotonic):
         from .. import config
         from ..plan.executor import PlanExecutor
         self.executor = executor if executor is not None \
             else PlanExecutor(mode="eager")
+        # an explicit per-scheduler stats store (fleet workers isolate
+        # theirs); None keeps the process-default active_store() wiring
+        self.stats_store = stats_store
+        self.feedback = (config.serving_feedback() if feedback is None
+                         else bool(feedback))
+        self.feedback_halflife_s = (
+            config.serving_feedback_halflife_s()
+            if feedback_halflife_s is None else float(feedback_halflife_s))
         self.workers = (config.serving_workers() if workers is None
                         else max(1, int(workers)))
         self.queue_depth = (config.serving_queue_depth()
@@ -358,6 +386,23 @@ class ServingScheduler:
         except Exception:
             return None
 
+    def _observed_charge(self, plan) -> Optional[int]:
+        """High-water OBSERVED live bytes for this authored plan on the
+        current backend (plan/stats.py), or None when cold / stats off.
+        Defensive None on any error — sizing must never fail a submit."""
+        from ..plan import stats as stats_mod
+        store = (self.stats_store if self.stats_store is not None
+                 else stats_mod.active_store())
+        if store is None:
+            return None
+        try:
+            import jax
+            obs = store.observed_peak_bytes(jax.default_backend(),
+                                            plan.fingerprint)
+        except Exception:
+            return None
+        return None if obs is None else int(obs[0])
+
     def _submit(self, state: _SessionState, plan, inputs: Optional[Dict],
                 *, block: Optional[bool], timeout: Optional[float]) -> Ticket:
         from ..analysis.footprint import quota_charge
@@ -390,6 +435,16 @@ class ServingScheduler:
         cert = self._certify(plan, inputs)
         charge, source, op_label = quota_charge(cert,
                                                 self.default_charge_bytes)
+        observed = self._observed_charge(plan)
+        if observed:
+            # warm fingerprint: what the plan DID is the better sizer
+            # than the sound-but-loose certified cross-product bound —
+            # but never charge above a certified ceiling (both bound the
+            # same execution, the tighter one wins)
+            charge = min(observed, charge) if source == "certified" \
+                else observed
+            source = "observed"
+        ticket.charge_source = source
         tier = "device"
         if charge > state.quota_bytes:
             # can NEVER fit this session's quota: resolve now, before any
@@ -456,6 +511,32 @@ class ServingScheduler:
         return job.tier == "cpu" or \
             state.in_flight_bytes + job.charge <= state.quota_bytes
 
+    # cost normalizer: one second of accumulated wall halves a session's
+    # effective weight; each retry charges like 100 ms of wall
+    _FEEDBACK_NORM_MS = 1000.0
+    _FEEDBACK_RETRY_MS = 100.0
+    # the decayed penalty never cuts a session below a quarter of its
+    # configured weight — feedback skews dispatch, it cannot starve
+    _FEEDBACK_FLOOR = 0.25
+
+    def _effective_weight_locked(self, s: _SessionState,
+                                 now: float) -> float:
+        """WDRR credit grant with the dispatch-fairness feedback loop
+        (docs/serving.md#fairness): sessions that have recently burned
+        disproportionate wall-ms / retries earn credit slower. The cost
+        score decays with a configurable half-life (one bad hour fades)
+        and the grant is floored at `_FEEDBACK_FLOOR x weight` (bounded
+        skew, never starvation). Feedback off => exactly `s.weight`."""
+        if not self.feedback:
+            return s.weight
+        if s.cost_score > 0.0 and self.feedback_halflife_s > 0:
+            dt = now - s.cost_at
+            if dt > 0:
+                s.cost_score *= 0.5 ** (dt / self.feedback_halflife_s)
+        s.cost_at = now
+        scaled = s.weight / (1.0 + s.cost_score / self._FEEDBACK_NORM_MS)
+        return max(scaled, self._FEEDBACK_FLOOR * s.weight)
+
     def _pick_locked(self) -> Optional[_Job]:
         """Next job to dispatch (scheduler lock held).
 
@@ -464,8 +545,10 @@ class ServingScheduler:
            session, whatever the lanes/weights say.
         2. Priority lanes in order; weighted deficit round-robin within a
            lane: each pass over the lane's eligible sessions grants
-           `weight` credit, a dispatch costs 1 credit — over time a
-           weight-2 session dispatches twice per weight-1 session's once.
+           `weight` credit (scaled down by the feedback cost score when
+           SPARK_RAPIDS_TPU_SERVING_FEEDBACK is on), a dispatch costs 1
+           credit — over time a weight-2 session dispatches twice per
+           weight-1 session's once.
         """
         eligible = [s for s in self._sessions.values() if self._eligible(s)]
         if not eligible:
@@ -495,7 +578,9 @@ class ServingScheduler:
                         self._rr[lane] = (cursor + i + 1) % len(members)
                         return self._take_locked(s)
                 for s in members:
-                    s.deficit = min(s.deficit + s.weight, 64.0)
+                    s.deficit = min(
+                        s.deficit + self._effective_weight_locked(s, now),
+                        64.0)
         return None
 
     def _take_locked(self, state: _SessionState) -> _Job:
@@ -554,7 +639,12 @@ class ServingScheduler:
                 served_hit = True
                 result = hit
             else:
-                with sessionctx.session_scope(state.id):
+                import contextlib
+                from ..plan import stats as stats_mod
+                scope = (stats_mod.scoped_store(self.stats_store)
+                         if self.stats_store is not None
+                         else contextlib.nullcontext())
+                with sessionctx.session_scope(state.id), scope:
                     result = self.executor.execute(
                         job.plan, job.inputs,
                         tier="cpu" if job.tier == "cpu" else None)
@@ -588,6 +678,14 @@ class ServingScheduler:
                         state.retries += result.retries
                         if result.degraded or job.tier == "cpu":
                             state.degraded += 1
+                        if self.feedback:
+                            if state.cost_score == 0.0:
+                                # anchor the decay clock: an untouched
+                                # cost_at of 0 would decay the first
+                                # accrual away instantly
+                                state.cost_at = self._clock()
+                            state.cost_score += float(result.wall_ms) + \
+                                self._FEEDBACK_RETRY_MS * result.retries
                 else:
                     state.failed += 1
                 self._maybe_reap_locked(state)
@@ -635,6 +733,7 @@ class ServingScheduler:
         """Snapshot: per-session accounting + queue/cache aggregates (the
         soak's assertion surface, docs/serving.md#observability)."""
         with self._lock:
+            now = self._clock()
             sessions = {
                 s.id: {"weight": s.weight, "priority": s.priority,
                        "quota_bytes": s.quota_bytes,
@@ -644,6 +743,9 @@ class ServingScheduler:
                        "rejected": s.rejected, "degraded": s.degraded,
                        "retries": s.retries, "cache_hits": s.cache_hits,
                        "aged_dispatches": s.aged_dispatches,
+                       "cost_score": round(s.cost_score, 3),
+                       "effective_weight": round(
+                           self._effective_weight_locked(s, now), 4),
                        "queue_wait_ms": s.wait_stats()}
                 for s in self._sessions.values()}
             queued, hiwater = self._queued, self._queued_hiwater
@@ -653,4 +755,19 @@ class ServingScheduler:
                 "queue_depth": self.queue_depth,
                 "workers": self.workers,
                 "cache": self.cache.stats(),
+                "breaker": self.executor.health.breaker.state}
+
+    def pressure(self) -> Dict:
+        """Cheap load signal for the fleet router (serving/fleet.py):
+        queued + active work, total in-flight certified charge, and the
+        breaker state — enough to rank workers for spillover without
+        touching per-session detail."""
+        with self._lock:
+            queued, active = self._queued, self._active
+            inflight = sum(s.in_flight_bytes
+                           for s in self._sessions.values())
+        return {"queued": queued, "active": active,
+                "in_flight_bytes": inflight,
+                "queue_depth": self.queue_depth,
+                "workers": self.workers,
                 "breaker": self.executor.health.breaker.state}

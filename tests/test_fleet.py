@@ -1,0 +1,405 @@
+"""Fleet serving tier tests (serving/fleet.py + serving/router.py,
+docs/serving.md#fleet).
+
+Router mechanics: consistent-hash stability under worker join/leave
+(bounded key movement — only the departed/arrived worker's arcs move),
+session-affinity pinning while work is in flight, load spillover off a
+synthetically hot worker, failover replay parity after a deliberate
+kill, cross-worker cache promotion (hit served by a different worker
+than computed it), and the invalidation bus dropping stale entries
+fleet-wide on an input-digest change.
+
+Regression (acceptance): with one worker — the knobs-unset default —
+fleet serving is byte-identical to the single-worker ServingScheduler
+path PR 15 shipped.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import spark_rapids_tpu  # noqa: F401
+from spark_rapids_tpu import Column, Table, dtypes
+from spark_rapids_tpu.plan import PlanBuilder, PlanExecutor, col
+from spark_rapids_tpu.serving import (FleetScheduler, HashRing,
+                                      ServingScheduler)
+from spark_rapids_tpu.serving.router import _point
+
+
+def _col(a):
+    a = np.asarray(a, dtype=np.int64)
+    return Column(dtype=dtypes.INT64, length=len(a), data=jnp.asarray(a))
+
+
+def _table(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    return Table([_col(rng.integers(0, 50, n)),
+                  _col(rng.integers(1, 100, n))], names=["k", "v"])
+
+
+def _plan(thr=10):
+    b = PlanBuilder()
+    return (b.scan("t", schema=["k", "v"]).filter(col("v") > thr)
+            .aggregate(["k"], [("v", "sum", "total")])
+            .sort(["k"]).build())
+
+
+def _solo(plan, t):
+    return PlanExecutor(mode="eager").execute(plan, {"t": t}).table.to_pydict()
+
+
+def _gate_workers(fleet, gate):
+    """Block every worker's executor on `gate` — the deterministic lever
+    for in-flight-shape tests (affinity, spillover, failover) without
+    sleeps-as-synchronization."""
+    for w in fleet._workers.values():
+        orig = w.executor.execute
+
+        def gated(plan, inputs=None, tier=None, _orig=orig):
+            assert gate.wait(timeout=30), "gate never released"
+            return _orig(plan, inputs, tier=tier)
+
+        w.executor.execute = gated
+
+
+def _plan_homed_at(fleet, wid, skip=()):
+    """A plan whose fingerprint ring-routes to worker `wid` (distinct
+    from any fingerprint in `skip`)."""
+    for thr in range(200):
+        p = _plan(thr)
+        if p.fingerprint in skip:
+            continue
+        if fleet._ring.route(p.fingerprint) == wid:
+            return p
+    raise AssertionError(f"no plan homed at {wid} in 200 tries")
+
+
+# ---- ring mechanics ---------------------------------------------------------
+
+def test_ring_leave_moves_only_departed_workers_keys():
+    ring = HashRing(replicas=64)
+    for w in ("w0", "w1", "w2", "w3"):
+        ring.add(w)
+    keys = [f"fingerprint-{i}" for i in range(300)]
+    before = {k: ring.route(k) for k in keys}
+    assert set(before.values()) == {"w0", "w1", "w2", "w3"}, \
+        "64 replicas should spread 300 keys over all 4 workers"
+    ring.remove("w1")
+    after = {k: ring.route(k) for k in keys}
+    for k in keys:
+        if before[k] != "w1":
+            assert after[k] == before[k], \
+                "a survivor's key re-homed on an unrelated departure"
+        else:
+            assert after[k] != "w1"
+    moved = sum(1 for k in keys if before[k] != after[k])
+    assert 0 < moved < len(keys) // 2, \
+        f"expected ~1/4 of keys to move, got {moved}/300"
+
+
+def test_ring_join_rehomes_only_onto_new_worker():
+    ring = HashRing(replicas=64)
+    for w in ("w0", "w1", "w2"):
+        ring.add(w)
+    keys = [f"fp-{i}" for i in range(300)]
+    before = {k: ring.route(k) for k in keys}
+    ring.add("w3")
+    after = {k: ring.route(k) for k in keys}
+    for k in keys:
+        if after[k] != before[k]:
+            assert after[k] == "w3", \
+                "a key moved between PRE-EXISTING workers on a join"
+    assert any(after[k] == "w3" for k in keys)
+    # leave again: the original mapping comes back exactly
+    ring.remove("w3")
+    assert {k: ring.route(k) for k in keys} == before
+
+
+def test_ring_is_deterministic_across_instances():
+    # blake2b points, not hash(): the mapping must survive process
+    # restart and Python hash randomization
+    a, b = HashRing(replicas=32), HashRing(replicas=32)
+    for w in ("w0", "w1", "w2"):
+        a.add(w)
+        b.add(w)
+    keys = [f"fp-{i}" for i in range(100)]
+    assert [a.route(k) for k in keys] == [b.route(k) for k in keys]
+    assert _point("w0#0") == _point("w0#0")
+
+
+def test_ring_membership_surface():
+    ring = HashRing(replicas=8)
+    assert ring.route("anything") is None
+    ring.add("w0")
+    assert ring.route("anything") == "w0"
+    assert "w0" in ring and len(ring) == 1
+    ring.remove("w0")
+    assert ring.route("anything") is None
+
+
+# ---- single-worker regression (acceptance) ----------------------------------
+
+def test_single_worker_fleet_is_byte_identical_to_scheduler():
+    """Fleet disabled (workers=1, the knobs-unset default): serving
+    behavior must be byte-identical to the single-worker
+    ServingScheduler path — same tables, same cached/charge_source
+    stamps, run for run."""
+    tables = [_table(seed=s) for s in (0, 1)]
+    plans = [_plan(thr) for thr in (5, 20)]
+    workload = [(p, t) for p in plans for t in tables] * 2  # repeats hit
+
+    def run_all(front):
+        out = []
+        s = front.open_session("tenant")
+        for p, t in workload:
+            tk = s.submit(p, {"t": t})
+            res = tk.result(timeout=120)
+            out.append((res.table.to_pydict(), res.cached,
+                        tk.charge_source))
+        s.close()
+        return out
+
+    # both sides get a fresh isolated stats store: the comparison is
+    # equal behavior given equal state — the global store's contents
+    # depend on what earlier tests happened to run
+    from spark_rapids_tpu.plan.stats import StatsStore
+    with ServingScheduler(workers=2,
+                          stats_store=StatsStore(path="")) as sched:
+        ref = run_all(sched)
+    with FleetScheduler(workers=1,
+                        scheduler_kwargs={"workers": 2}) as fleet:
+        got = run_all(fleet)
+        m = fleet.metrics()
+        assert m["routes_spill"] == 0
+        assert list(m["workers"]) == ["w0"]
+    assert got == ref
+
+
+# ---- routing policy ---------------------------------------------------------
+
+def test_consistent_hash_routes_spread_and_repeat():
+    with FleetScheduler(workers=3,
+                        scheduler_kwargs={"cache_entries": 0}) as fleet:
+        s = fleet.open_session("a")
+        t = _table()
+        first = {}
+        for thr in range(12):
+            p = _plan(thr)
+            tk = s.submit(p, {"t": t})
+            tk.result(timeout=120)
+            first[p.fingerprint] = tk.worker
+        assert len(set(first.values())) > 1, \
+            "12 distinct fingerprints all routed to one worker"
+        # resubmit: same fingerprint -> same worker, every time
+        for thr in range(12):
+            p = _plan(thr)
+            tk = s.submit(p, {"t": t})
+            tk.result(timeout=120)
+            assert tk.worker == first[p.fingerprint]
+
+
+def test_session_affinity_pins_inflight_work():
+    gate = threading.Event()
+    with FleetScheduler(workers=3,
+                        scheduler_kwargs={"cache_entries": 0,
+                                          "workers": 1}) as fleet:
+        _gate_workers(fleet, gate)
+        s = fleet.open_session("a")
+        t = _table()
+        # distinct fingerprints whose ring homes differ — affinity must
+        # override the ring while work is in flight
+        plans = [_plan(thr) for thr in range(4)]
+        homes = {fleet._ring.route(p.fingerprint) for p in plans}
+        assert len(homes) > 1, "pick plans with differing ring homes"
+        tickets = [s.submit(p, {"t": t}) for p in plans]
+        pinned = {tk.worker for tk in tickets}
+        gate.set()
+        solos = [_solo(p, t) for p in plans]
+        for tk, ref in zip(tickets, solos):
+            assert tk.result(timeout=120).table.to_pydict() == ref
+        assert len(pinned) == 1, \
+            f"in-flight session spread across workers: {pinned}"
+        assert fleet.metrics()["routes_affinity"] >= 3
+
+
+def test_spillover_sheds_hot_worker():
+    gate = threading.Event()
+    with FleetScheduler(workers=3, spill_ratio=1.5,
+                        scheduler_kwargs={"cache_entries": 0,
+                                          "workers": 1}) as fleet:
+        _gate_workers(fleet, gate)
+        hot = "w0"
+        p_hot = _plan_homed_at(fleet, hot)
+        p_also_hot = _plan_homed_at(fleet, hot,
+                                    skip={p_hot.fingerprint})
+        t = _table()
+        # session a piles work onto the hot worker (ring + affinity)
+        sa = fleet.open_session("a")
+        backlog = [sa.submit(p_hot, {"t": t}) for _ in range(4)]
+        assert all(tk.worker == hot for tk in backlog)
+        # session b's plan ALSO homes at the hot worker — pressure there
+        # exceeds spill_ratio x (idle + 1), so it must shed
+        sb = fleet.open_session("b")
+        tk = sb.submit(p_also_hot, {"t": t})
+        assert tk.worker != hot, "submission queued behind the hot spot"
+        assert fleet.metrics()["routes_spill"] >= 1
+        gate.set()
+        ref = _solo(p_also_hot, t)
+        assert tk.result(timeout=120).table.to_pydict() == ref
+        for b in backlog:
+            b.result(timeout=120)
+
+
+# ---- failover ---------------------------------------------------------------
+
+def test_kill_worker_replays_inflight_with_parity():
+    gate = threading.Event()
+    with FleetScheduler(workers=2,
+                        scheduler_kwargs={"cache_entries": 0,
+                                          "workers": 1}) as fleet:
+        _gate_workers(fleet, gate)
+        s = fleet.open_session("a")
+        t = _table()
+        plans = [_plan(thr) for thr in range(3)]
+        tickets = [s.submit(p, {"t": t}) for p in plans]
+        victim = tickets[0].worker           # affinity pinned all three
+        assert all(tk.worker == victim for tk in tickets)
+        survivor = next(w for w in fleet._workers if w != victim)
+        # release the gate as the kill drains the victim: the active job
+        # finishes on the dying worker (its result stands), the queued
+        # jobs fail typed-closed and replay on the survivor
+        releaser = threading.Timer(0.3, gate.set)
+        releaser.start()
+        try:
+            replayed = fleet.kill_worker(victim)
+        finally:
+            releaser.join()
+        assert replayed >= 2
+        for tk, p in zip(tickets, plans):
+            res = tk.result(timeout=120)
+            assert res.table.to_pydict() == _solo(p, t), \
+                "failover replay broke bit-exact parity"
+        assert any(tk.worker == survivor for tk in tickets[1:])
+        m = fleet.metrics()
+        assert m["failovers"] == 1 and m["replayed_jobs"] >= 2
+        assert m["ring"] == [survivor]
+        # the fleet keeps serving on the survivor
+        res = s.run(_plan(50), {"t": t})
+        assert res.table.to_pydict() == _solo(_plan(50), t)
+
+
+def test_kill_refuses_last_live_worker():
+    with FleetScheduler(workers=1) as fleet:
+        with pytest.raises(ValueError, match="last live worker"):
+            fleet.kill_worker("w0")
+
+
+def test_reap_unhealthy_fails_over_stuck_open_breaker():
+    with FleetScheduler(workers=2,
+                        scheduler_kwargs={"workers": 1}) as fleet:
+        w = fleet._workers["w0"]
+        w.health.breaker.cooldown_s = 0   # no self-arm: stuck OPEN
+        w.health.breaker.trip("test", detail="forced")
+        assert fleet.reap_unhealthy() == ["w0"]
+        assert not w.alive and fleet.metrics()["ring"] == ["w1"]
+        # a breaker WITH a cooldown is left to recover by itself
+        w1 = fleet._workers["w1"]
+        w1.health.breaker.cooldown_s = 60
+        w1.health.breaker.trip("test", detail="forced")
+        assert fleet.reap_unhealthy() == []
+        assert w1.alive
+
+
+def test_worker_join_scales_out():
+    with FleetScheduler(workers=2,
+                        scheduler_kwargs={"cache_entries": 0}) as fleet:
+        keys = [_plan(thr).fingerprint for thr in range(20)]
+        before = {k: fleet._ring.route(k) for k in keys}
+        wid = fleet.add_worker()
+        assert wid == "w2"
+        after = {k: fleet._ring.route(k) for k in keys}
+        for k in keys:
+            if after[k] != before[k]:
+                assert after[k] == wid
+        # the new worker actually serves
+        s = fleet.open_session("a")
+        p = _plan_homed_at(fleet, wid)
+        t = _table()
+        tk = s.submit(p, {"t": t})
+        assert tk.result(timeout=120).table.to_pydict() == _solo(p, t)
+        assert tk.worker == wid
+
+
+# ---- cross-worker cache promotion + invalidation bus ------------------------
+
+def test_cache_hit_served_by_different_worker_than_computed():
+    """The acceptance proof shape: a plan computed OFF its ring home
+    (here: directly on a peer) is promoted to the home worker's cache on
+    the next ring-routed submission — the hit is SERVED by the home
+    worker while the result still names the worker that COMPUTED it."""
+    with FleetScheduler(workers=3,
+                        scheduler_kwargs={"workers": 1}) as fleet:
+        p, t = _plan(15), _table()
+        home = fleet._ring.route(p.fingerprint)
+        peer = next(w for w in fleet._workers if w != home)
+        direct = fleet._workers[peer].scheduler.open_session("direct")
+        direct.run(p, {"t": t})              # computed + cached on peer
+        s = fleet.open_session("a")
+        tk = s.submit(p, {"t": t})
+        res = tk.result(timeout=120)
+        assert res.cached, "promotion should have produced a hit"
+        assert tk.worker == home
+        assert res.worker == peer, \
+            "the served copy must name the COMPUTING worker"
+        assert tk.worker != res.worker
+        assert res.table.to_pydict() == _solo(p, t)
+        assert fleet.metrics()["cache_promotions"] >= 1
+
+
+def test_invalidation_bus_drops_stale_entries_fleetwide():
+    with FleetScheduler(workers=2,
+                        scheduler_kwargs={"workers": 1}) as fleet:
+        p = _plan(15)
+        t_old, t_new = _table(seed=0), _table(seed=7)
+        s = fleet.open_session("a")
+        s.run(p, {"t": t_old})     # fleet records the digest, home caches
+        # seed the OTHER worker's cache with the same stale entry
+        home = fleet._ring.route(p.fingerprint)
+        other = next(w for w in fleet._workers if w != home)
+        fleet._workers[other].scheduler.open_session("d").run(
+            p, {"t": t_old})
+        caches = [w.scheduler.cache for w in fleet._workers.values()]
+        assert all(c.stats()["entries"] >= 1 for c in caches)
+        # same plan, CHANGED data: the bus must drop old-digest entries
+        # on every worker, and the fresh run must see the new rows
+        res = s.run(p, {"t": t_new})
+        assert res.table.to_pydict() == _solo(p, t_new)
+        assert not res.cached
+        assert fleet.metrics()["bus_publishes"] == 1
+        from spark_rapids_tpu.serving.cache import cache_key
+        stale_keys = [k for c in caches for k in c._data
+                      if k[0] == p.fingerprint
+                      and k != cache_key(p, {"t": t_new})]
+        assert stale_keys == [], f"stale entries survived: {stale_keys}"
+        # stats observations over the old data are forgotten too
+        import jax
+        backend = jax.default_backend()
+        for w in fleet._workers.values():
+            peak = w.stats.observed_peak_bytes(backend, p.fingerprint)
+            assert peak is None or w.id == home, \
+                "non-home stats kept observations for vanished data"
+
+
+def test_bus_keeps_new_digest_entry_sound():
+    with FleetScheduler(workers=2,
+                        scheduler_kwargs={"workers": 1}) as fleet:
+        p = _plan(15)
+        t_old, t_new = _table(seed=0), _table(seed=7)
+        s = fleet.open_session("a")
+        s.run(p, {"t": t_old})
+        s.run(p, {"t": t_new})               # publishes the invalidation
+        res = s.run(p, {"t": t_new})         # repeat: must HIT, new data
+        assert res.cached
+        assert res.table.to_pydict() == _solo(p, t_new)

@@ -28,6 +28,11 @@ Two AST rules over ``benchmarks/`` and ``bench.py``:
   docs/serving.md: a queue wait or a cache-served number without its
   tenant session is not attributable — and a cached row measured no
   execution at all, so consumers must be able to filter it).
+- ``missing-worker-id-stamp``: a call that stamps ``replays=`` (a
+  fleet-layer record, serving/fleet.py) must also stamp ``worker_id=``
+  — a fleet completion without the worker that served it cannot be
+  attributed across the failover/replay trajectory the number exists
+  to describe (docs/serving.md#fleet).
 - ``raw-jsonl-missing-stamp``: a ``json.dumps({...literal...})`` record
   must carry ``"backend"`` and ``"kernels"`` keys — unless it carries an
   ``"error"`` key (failure records describe infrastructure, not
@@ -106,6 +111,13 @@ def _lint_file(path: str, rel: str, findings: List[str]) -> None:
                     "session= — a serving-layer number without its "
                     "tenant session is not attributable "
                     "(serving/scheduler.py, docs/serving.md)")
+            if "replays" in kw and "worker_id" not in kw:
+                findings.append(
+                    f"{rel}:{node.lineno}: [missing-worker-id-stamp] "
+                    f"{name}() stamps replays= without worker_id= — a "
+                    "fleet-layer completion without the worker that "
+                    "served it is not attributable across failover "
+                    "(serving/fleet.py, docs/serving.md#fleet)")
         elif name == "dumps" and node.args and \
                 isinstance(node.args[0], ast.Dict):
             keys = {k.value for k in node.args[0].keys
