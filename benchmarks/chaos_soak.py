@@ -44,6 +44,14 @@ parity-checked cache hit SERVED by a different worker than the one that
 COMPUTED it (the consistent-hash locality + promotion proof). Each
 session's JSONL row carries the `worker_id` stamp alongside the serving
 stamps (lint_metrics-enforced for fleet-path rows).
+
+Lockdep-armed soak (SPARK_RAPIDS_TPU_LOCKDEP=1, any mode): every
+engine lock is constructed through the runtime lock-order witness
+(runtime/lockdep.py), rows stamp `lockdep_edges`/`lockdep_cycles`, and
+the soak FAILS on any observed lock-order cycle or any dynamic edge
+missing from tools/lint_concurrency.py's static graph — the nightly's
+empirical audit of the linter's interprocedural resolution
+(docs/analysis.md#concurrency-invariants).
 """
 import os
 import sys
@@ -55,7 +63,65 @@ os.environ.setdefault("SPARK_RAPIDS_TPU_BREAKER_BACKOFF_MAX_MS", "8")
 
 sys.path.insert(0, ".")
 
+# Lock-order witness (runtime/lockdep.py, docs/analysis.md#concurrency-
+# invariants): when the nightly arms SPARK_RAPIDS_TPU_LOCKDEP=1, the
+# tracing factories must be installed BEFORE the engine — or
+# benchmarks.common, which pulls it in — is imported, so module-level
+# locks are constructed wrapped. The env var is read directly because
+# importing config would import the engine first; the knob is latched
+# here at install time.
+_LOCKDEP = None
+if os.environ.get("SPARK_RAPIDS_TPU_LOCKDEP", "0").lower() \
+        not in ("0", "", "off"):
+    import importlib.util as _ilu
+    _root = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                         os.pardir))
+    _spec = _ilu.spec_from_file_location(
+        "spark_rapids_tpu.runtime.lockdep",
+        os.path.join(_root, "spark_rapids_tpu", "runtime", "lockdep.py"))
+    _LOCKDEP = _ilu.module_from_spec(_spec)
+    sys.modules[_spec.name] = _LOCKDEP
+    _spec.loader.exec_module(_LOCKDEP)
+    _LOCKDEP.install()
+
 from benchmarks.common import emit_record, parse_args  # noqa: E402
+
+
+def _lockdep_stats():
+    """(edge classes, cycles) the witness observed so far, or
+    (None, None) unarmed — emit_record omits None fields."""
+    if _LOCKDEP is None:
+        return None, None
+    snap = _LOCKDEP.snapshot()
+    return len(snap["edges"]), len(snap["cycles"])
+
+
+def _lockdep_certify():
+    """Armed-soak verdict: any observed lock-order cycle, or any
+    dynamic edge the static linter (tools/lint_concurrency.py) failed
+    to predict, fails the soak even though every result had parity."""
+    if _LOCKDEP is None:
+        return
+    rep = _LOCKDEP.certify()
+    print(f"lockdep: {rep['observed']} observed edge class(es): "
+          f"{len(rep['mapped'])} mapped to the static graph, "
+          f"{len(rep['missing'])} missing from it, "
+          f"{len(rep['unmapped'])} at unmodeled sites; "
+          f"{len(rep['cycles'])} cycle(s)")
+    if not rep["ok"]:
+        for m in rep["missing"]:
+            print(f"lockdep: dynamic edge NOT in static graph: {m}")
+        for c in rep["cycles"]:
+            print(f"lockdep: observed lock-order cycle: {c}")
+        raise SystemExit("lockdep: the armed soak observed a lock-order "
+                         "cycle or an edge the static linter missed")
+    if rep["observed"] == 0:
+        # the fleet/serving paths provably nest locks; observing none
+        # means the witness never traced (an install-ordering or path-
+        # normalization regression) — same rule as zero injected faults
+        raise SystemExit("lockdep ineffective: the armed soak observed "
+                         "ZERO lock-order edges — the witness is not "
+                         "actually tracing")
 
 CONFIG = os.path.join(os.path.dirname(__file__), os.pardir, "configs",
                       "chaos_soak.json")
@@ -167,6 +233,7 @@ def soak_serving(args):
                                  f"after recovery (cached={hot.cached})")
             m = sched.metrics()          # refresh: include recovery runs
             cache_hits = m["cache"]["hits"]
+            ld_edges, ld_cycles = _lockdep_stats()
             for sid, s in sorted(m["sessions"].items()):
                 last = per_session[sid][-1]
                 emit_record(
@@ -180,9 +247,11 @@ def soak_serving(args):
                     kernels=kernels_of(last),
                     retries=s["retries"], degraded=s["degraded"] > 0,
                     faults_injected=faults,
+                    lockdep_edges=ld_edges, lockdep_cycles=ld_cycles,
                     breaker=m["breaker"])
     finally:
         faultinj.uninstall()        # idempotent; recovery already uninstalled
+    _lockdep_certify()
     print(f"serving soak OK: {n_sessions} sessions x {plans_per_session} "
           f"plans, {faults} faults injected, {degraded} degraded, "
           f"{cache_hits} cache hits served, p99 queue wait {p99:.1f} ms, "
@@ -337,6 +406,7 @@ def soak_fleet(args):
                     f"by {tk.worker}, computed by {hot.worker or '?'}) "
                     "— consistent-hash locality unproven")
             fm = fleet.metrics()
+            ld_edges, ld_cycles = _lockdep_stats()
             for sid in sorted(per_session):
                 tk_last, res_last = per_session[sid][-1]
                 emit_record(
@@ -352,9 +422,11 @@ def soak_fleet(args):
                     retries=sum(r.retries for _, r in per_session[sid]),
                     degraded=any(r.degraded for _, r in per_session[sid]),
                     faults_injected=faults,
+                    lockdep_edges=ld_edges, lockdep_cycles=ld_cycles,
                     replays=sum(t.replays for t, _ in per_session[sid]))
     finally:
         faultinj.uninstall()
+    _lockdep_certify()
     print(f"fleet soak OK: {n_sessions} sessions x {plans_per_session} "
           f"plans over {n_workers} workers, killed {victim} mid-storm "
           f"({replayed} jobs replayed, {fm['replayed_jobs']} total), "
@@ -406,10 +478,12 @@ def main(argv=None):
             totals["retries"] += res.retries
             totals["faults"] += faults
             totals["degraded"] += int(res.degraded)
+            ld_edges, ld_cycles = _lockdep_stats()
             emit_record("chaos_soak", {"query": q, "rows": n}, ms, n,
                         impl="plan_eager", retries=res.retries,
                         kernels=kernels_of(res),
                         faults_injected=faults, degraded=res.degraded,
+                        lockdep_edges=ld_edges, lockdep_cycles=ld_cycles,
                         breaker=res.breaker["state"])
             return res
 
@@ -433,6 +507,7 @@ def main(argv=None):
         raise SystemExit(f"chaos soak ineffective: {totals} (health "
                          f"counters {health}) — fault config injected "
                          "nothing worth recovering from")
+    _lockdep_certify()
     print(f"chaos soak OK: {totals['faults']} faults injected, "
           f"{totals['retries']} retries, {totals['degraded']} degraded "
           f"completions, breaker closed")

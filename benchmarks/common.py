@@ -138,6 +138,8 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
                 queue_wait_ms: float = None,
                 cache_hit: bool = None,
                 worker_id: str = None,
+                lockdep_edges: int = None,
+                lockdep_cycles: int = None,
                 **extra) -> Dict:
     """Build + print one bench JSONL record.
 
@@ -202,6 +204,15 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
     decoded), `io_overlap_ms` (host decode that ran concurrently with
     execution — the prefetch pipeline's measured win).
 
+    Optional lockdep fields (armed chaos-soak rows, i.e. runs with
+    SPARK_RAPIDS_TPU_LOCKDEP=1 — runtime/lockdep.py,
+    docs/analysis.md#concurrency-invariants): `lockdep_edges` (observed
+    lock-order edge classes accumulated by the witness at emit time)
+    and `lockdep_cycles` (observed cycles — any nonzero fails the
+    soak). Stamped so the nightly JSONL history shows whether a soak
+    row ran under the witness's overhead and how much lock-order
+    coverage it exercised.
+
     Optional kernel-registry field (benchmarks/kernel_bench.py, the
     `*_kernels` plan variants; docs/kernels.md): `kernels` — the per-op
     kernel choices the measured run actually dispatched (a dict like
@@ -242,6 +253,10 @@ def emit_record(bench: str, axes: Dict, ms: float, n_rows: int, *,
         rec["cache_hit"] = bool(cache_hit)
     if worker_id is not None:
         rec["worker_id"] = worker_id
+    if lockdep_edges is not None:
+        rec["lockdep_edges"] = int(lockdep_edges)
+    if lockdep_cycles is not None:
+        rec["lockdep_cycles"] = int(lockdep_cycles)
     if retries is not None:
         rec["retries"] = retries
     if faults_injected is not None:

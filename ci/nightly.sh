@@ -41,6 +41,14 @@ JAX_PLATFORMS=cpu python benchmarks/chaos_soak.py --scale 0.2 --cpu --sessions 8
 # than the one that COMPUTED it (consistent-hash locality + promotion);
 # per-session JSONL rows carry the worker_id stamp (lint_metrics-enforced)
 JAX_PLATFORMS=cpu python benchmarks/chaos_soak.py --scale 0.2 --cpu --sessions 8 --workers 3
+# lockdep-armed fleet soak (runtime/lockdep.py, docs/analysis.md#
+# concurrency-invariants): the same storm with every engine lock traced
+# by the runtime lock-order witness — FAILS on any observed lock-order
+# cycle or any dynamic edge missing from the static linter's graph
+# (tools/lint_concurrency.py), and rows stamp lockdep_edges/
+# lockdep_cycles so the JSONL history shows witness coverage
+JAX_PLATFORMS=cpu SPARK_RAPIDS_TPU_LOCKDEP=1 \
+    python benchmarks/chaos_soak.py --scale 0.2 --cpu --sessions 8 --workers 3
 # optimizer parity (docs/optimizer.md): the four NDS plans, capped tier,
 # optimizer off vs on — asserts result parity, nonzero pruned-column
 # counts on q5/q72, and a fingerprint-keyed jit-cache hit on a rebuilt

@@ -17,6 +17,14 @@ python tools/lint_hazards.py spark_rapids_tpu
 # run_config call site stamps `kernels`, every raw JSONL record carries
 # backend/n_devices/kernels — the ROADMAP cross-cutting rule, enforced
 python tools/lint_metrics.py
+# concurrency linter (tools/lint_concurrency.py, docs/analysis.md#
+# concurrency-invariants): whole-tree lock-order graph (interprocedural
+# "calls F while holding L" edges, any cycle fails with a witness path),
+# unbounded blocking calls reached under a lock, and FleetWorker
+# isolation (worker-owned state only via the sanctioned surfaces);
+# vetted exceptions + witness-proven `edge::` declarations live in
+# tools/lint_concurrency_allowlist.txt — STALE entries fail the run
+python tools/lint_concurrency.py
 # fixed fuzz corpus (analysis/fuzz.py): 24 seeded random plans covering
 # all 11 node kinds — verify + optimize (per-rule re-validation) + eager
 # optimized-vs-unoptimized parity + cold-vs-warm adaptive parity +

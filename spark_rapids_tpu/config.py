@@ -53,6 +53,7 @@ equivalents, all read at use time (not import time) so tests can monkeypatch:
 | SPARK_RAPIDS_TPU_FLEET_WORKERS | 1 | fleet serving tier (serving/fleet.py, docs/serving.md#fleet): executor workers behind the router; 1 (default) keeps the single-worker ServingScheduler path byte-identical |
 | SPARK_RAPIDS_TPU_FLEET_RING_REPLICAS | 64 | consistent-hash ring virtual nodes per worker — higher spreads fingerprints more evenly at slightly more route cost |
 | SPARK_RAPIDS_TPU_FLEET_SPILL_RATIO | 2.0 | load-aware spillover threshold: the routed worker sheds to the least-pressured replica when its pressure score exceeds ratio x (best score + 1); <=0 disables spillover |
+| SPARK_RAPIDS_TPU_LOCKDEP         | 0    | runtime lock-order witness (runtime/lockdep.py, docs/analysis.md#concurrency-invariants): wrap engine locks, record held-set→acquired edges, raise on the first observed ordering cycle; armed by tests/conftest and the fleet chaos soak |
 
 The SPARK_RAPIDS_TPU_BREAKER_* numeric knobs are snapshotted when a
 `DeviceHealthMonitor` is constructed (one policy per monitor lifetime —
@@ -548,3 +549,18 @@ def groupby_kernel() -> str:
             f"SPARK_RAPIDS_TPU_GROUPBY_KERNEL={v!r}: expected auto, scan, "
             "or scatter")
     return v
+
+
+def lockdep() -> bool:
+    """Runtime lock-order witness gate (runtime/lockdep.py,
+    docs/analysis.md#concurrency-invariants): SPARK_RAPIDS_TPU_LOCKDEP=1
+    wraps every engine-constructed lock in a tracing proxy that records
+    per-thread held-set -> acquired edges and raises LockOrderViolation
+    on the first observed ordering cycle. Armed suite-wide by
+    tests/conftest and in the fleet chaos soak; off (default) means zero
+    overhead. Note the knob is latched where the witness is INSTALLED
+    (conftest / chaos_soak read it once before importing the engine, so
+    module-level locks get wrapped) — flipping it mid-process does not
+    re-wrap existing locks."""
+    return os.environ.get("SPARK_RAPIDS_TPU_LOCKDEP", "0") not in (
+        "0", "", "off")

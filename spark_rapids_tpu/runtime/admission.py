@@ -49,7 +49,13 @@ def set_active_session(session: Optional[DeviceSession]) -> None:
     RmmSpark.setEventHandler). Pass None to uninstall."""
     global _global_session
     with _global_lock:
+        old = _global_session
         _global_session = session
+    # Drop the displaced session's reference OUTSIDE the lock: its teardown
+    # runs weakref finalizers (buffer releases -> arbiter.dealloc under
+    # ResourceArbiter._close_lock), and a finalizer that reached back into
+    # this module would self-deadlock on the plain Lock above.
+    del old
 
 
 def get_active_session() -> Optional[DeviceSession]:

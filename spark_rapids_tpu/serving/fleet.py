@@ -148,6 +148,14 @@ class FleetTicket:
         with self._lock:
             return self._inner, self._inner_worker
 
+    def _fail(self, err: BaseException) -> None:
+        """Terminal failure, under the ticket lock — `done()`/`result()`
+        read `_failed` under the same lock, so a lock-free write here
+        (the pre-lockdep bug) could be reordered past a concurrent
+        `done()` that already answered False and will never re-poll."""
+        with self._lock:
+            self._failed = err
+
     def done(self) -> bool:
         with self._lock:
             if self._failed is not None:
@@ -576,9 +584,9 @@ class FleetScheduler:
         with self._lock:
             rec = self._sessions.get(ticket.session)
             if rec is None:
-                ticket._failed = ServingRejectedError(
+                ticket._fail(ServingRejectedError(
                     "closed", "session gone during failover",
-                    session=ticket.session)
+                    session=ticket.session))
                 return
             # already re-bound by a racing replay?
             cur_w = ticket._current()[1]
@@ -588,7 +596,7 @@ class FleetScheduler:
             try:
                 w = self._route_locked(rec, ticket.plan)
             except ServingRejectedError as e:
-                ticket._failed = e
+                ticket._fail(e)
                 return
             handle = self._handle_locked(rec, w)
             self.replayed_jobs += 1
@@ -596,7 +604,7 @@ class FleetScheduler:
         try:
             inner = handle.submit(ticket.plan, ticket.inputs)
         except BaseException as e:
-            ticket._failed = e
+            ticket._fail(e)
             return
         ticket._bind(inner, w.id)
 

@@ -36,6 +36,29 @@ if "xla_force_host_platform_device_count" not in flags and not TPU_SMOKE:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Runtime lock-order witness (runtime/lockdep.py, docs/analysis.md#
+# concurrency-invariants): armed for the WHOLE suite by
+# SPARK_RAPIDS_TPU_LOCKDEP=1. The module is loaded standalone and
+# installed BEFORE any engine import so module-level locks (serving/
+# cache's _digest_lock, plan/stats' _default_lock) are constructed
+# through the patched factories; seeding sys.modules under the real
+# dotted name makes every later `import spark_rapids_tpu.runtime.
+# lockdep` resolve to this same instance. The env var is read directly
+# (not via config.lockdep()) because importing the config module would
+# import the engine package first — exactly what must not happen yet.
+_LOCKDEP = None
+if os.environ.get("SPARK_RAPIDS_TPU_LOCKDEP", "0").lower() \
+        not in ("0", "", "off"):
+    import importlib.util
+    _root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    _spec = importlib.util.spec_from_file_location(
+        "spark_rapids_tpu.runtime.lockdep",
+        os.path.join(_root, "spark_rapids_tpu", "runtime", "lockdep.py"))
+    _LOCKDEP = importlib.util.module_from_spec(_spec)
+    sys.modules[_spec.name] = _LOCKDEP
+    _spec.loader.exec_module(_LOCKDEP)
+    _LOCKDEP.install()
+
 # The axon sitecustomize imports jax at interpreter startup, so the env vars
 # above are too late for jax.config — override it directly as well.
 import jax  # noqa: E402
@@ -159,6 +182,27 @@ def _shed_xla_map_pressure():
     yield
     if _proc_map_count() > _MAPS_HIGH_WATER:
         jax.clear_caches()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Armed-run verdict: observed lock-order cycles or dynamic edges
+    the static linter failed to predict FAIL the suite even when every
+    test passed — the witness audits tools/lint_concurrency.py's
+    interprocedural resolution on every armed run."""
+    if _LOCKDEP is None or not _LOCKDEP.active():
+        return
+    rep = _LOCKDEP.certify()
+    print(f"\nlockdep: {rep['observed']} observed edge class(es): "
+          f"{len(rep['mapped'])} mapped to the static graph, "
+          f"{len(rep['missing'])} missing from it, "
+          f"{len(rep['unmapped'])} at unmodeled sites; "
+          f"{len(rep['cycles'])} cycle(s)")
+    for m in rep["missing"]:
+        print(f"lockdep: dynamic edge NOT in static graph: {m}")
+    for c in rep["cycles"]:
+        print(f"lockdep: observed lock-order cycle: {c}")
+    if not rep["ok"]:
+        session.exitstatus = 1
 
 
 # Persistent compilation cache: the suite jit-compiles hundreds of programs

@@ -403,3 +403,39 @@ def test_bus_keeps_new_digest_entry_sound():
         res = s.run(p, {"t": t_new})         # repeat: must HIT, new data
         assert res.cached
         assert res.table.to_pydict() == _solo(p, t_new)
+
+
+def test_ticket_fail_is_visible_to_concurrent_done():
+    """FleetTicket._fail writes under the ticket lock (the lockdep tier
+    caught the original lock-free write): once _fail returns, EVERY
+    concurrent/subsequent done() answers True and result() raises —
+    hammered from readers racing the failing writer."""
+    from spark_rapids_tpu.serving.fleet import FleetTicket
+
+    for _ in range(20):
+        t = FleetTicket(None, "s", None, None)
+        seen_after_fail = []
+        failed = threading.Event()
+
+        def reader():
+            while not t.done():
+                if failed.is_set():
+                    # _fail returned before this check: done() above
+                    # must have been True next round — loop once more
+                    if t.done():
+                        break
+                    seen_after_fail.append("done() False after _fail")
+                    return
+            seen_after_fail.append("ok")
+
+        threads = [threading.Thread(target=reader) for _ in range(4)]
+        for th in threads:
+            th.start()
+        t._fail(RuntimeError("boom"))
+        failed.set()
+        for th in threads:
+            th.join(5.0)
+        assert seen_after_fail == ["ok"] * 4, seen_after_fail
+        with pytest.raises(RuntimeError, match="boom"):
+            t.result(timeout=0.1)
+        assert t.done()

@@ -1,0 +1,368 @@
+"""Concurrency linter (tools/lint_concurrency.py): per-rule miniature
+modules, the shared static lock-graph vocabulary, allowlist policy,
+and the tree-clean premerge contract. Also pins the lint_hazards
+lock-discipline extension that recognizes ``threading.Condition``
+structurally (docs/analysis.md#concurrency-invariants)."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod       # dataclass decorators need the module
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def lint():
+    return _load_tool("lint_concurrency")
+
+
+def _analyze(lint, tmp_path, declared=()):
+    model = lint.build_model([str(tmp_path)], str(tmp_path))
+    lint._find_cycles(model, list(declared))
+    return model
+
+
+# ---------------------------------------------------------------------------
+# lock-order cycles
+# ---------------------------------------------------------------------------
+
+class TestLockOrderCycle:
+    def test_two_lock_cycle_nested_with(self, lint, tmp_path):
+        (tmp_path / "cyc.py").write_text(
+            "import threading\n"
+            "LOCK_A = threading.Lock()\n"
+            "LOCK_B = threading.Lock()\n"
+            "def fwd():\n"
+            "    with LOCK_A:\n"
+            "        with LOCK_B:\n"
+            "            pass\n"
+            "def rev():\n"
+            "    with LOCK_B:\n"
+            "        with LOCK_A:\n"
+            "            pass\n")
+        model = _analyze(lint, tmp_path)
+        cyc = [f for f in model.findings if f.rule == "lock-order-cycle"]
+        assert len(cyc) == 1, model.findings
+        assert "LOCK_A" in cyc[0].message and "LOCK_B" in cyc[0].message
+        # the witness path names the functions that created each edge
+        assert "fwd" in cyc[0].message and "rev" in cyc[0].message
+
+    def test_interprocedural_cycle_via_method_calls(self, lint, tmp_path):
+        """`calls F while holding L` edges: neither function nests two
+        `with` blocks — the inversion only exists across the call
+        graph (and through `self._x = param` attribute typing)."""
+        (tmp_path / "ipc.py").write_text(
+            "import threading\n"
+            "class A:\n"
+            "    def __init__(self, b: 'B'):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._b = b\n"
+            "    def step(self):\n"
+            "        with self._mu:\n"
+            "            self._b.poke()\n"
+            "    def ping(self):\n"
+            "        with self._mu:\n"
+            "            pass\n"
+            "class B:\n"
+            "    def __init__(self, a: A):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._a = a\n"
+            "    def poke(self):\n"
+            "        with self._mu:\n"
+            "            pass\n"
+            "    def kick(self):\n"
+            "        with self._mu:\n"
+            "            self._a.ping()\n")
+        model = _analyze(lint, tmp_path)
+        assert ("ipc.py:A._mu", "ipc.py:B._mu") in model.edges
+        assert ("ipc.py:B._mu", "ipc.py:A._mu") in model.edges
+        cyc = [f for f in model.findings if f.rule == "lock-order-cycle"]
+        assert len(cyc) == 1, model.findings
+
+    def test_consistent_order_is_clean(self, lint, tmp_path):
+        (tmp_path / "ok.py").write_text(
+            "import threading\n"
+            "LOCK_A = threading.Lock()\n"
+            "LOCK_B = threading.Lock()\n"
+            "def one():\n"
+            "    with LOCK_A:\n"
+            "        with LOCK_B:\n"
+            "            pass\n"
+            "def two():\n"
+            "    with LOCK_A:\n"
+            "        with LOCK_B:\n"
+            "            pass\n")
+        model = _analyze(lint, tmp_path)
+        assert not model.findings, model.findings
+        assert ("ok.py:LOCK_A", "ok.py:LOCK_B") in model.edges
+
+    def test_declared_edge_joins_cycle_check(self, lint, tmp_path):
+        """An allowlist `edge::` declaration that completes a cycle with
+        a derived edge FAILS — declarations extend the graph, they do
+        not bypass it."""
+        (tmp_path / "m.py").write_text(
+            "import threading\n"
+            "LOCK_A = threading.Lock()\n"
+            "LOCK_B = threading.Lock()\n"
+            "def fwd():\n"
+            "    with LOCK_A:\n"
+            "        with LOCK_B:\n"
+            "            pass\n")
+        model = _analyze(lint, tmp_path,
+                         declared=[("m.py:LOCK_B", "m.py:LOCK_A")])
+        cyc = [f for f in model.findings if f.rule == "lock-order-cycle"]
+        assert len(cyc) == 1, model.findings
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+
+class TestBlockingUnderLock:
+    def test_wait_without_timeout(self, lint, tmp_path):
+        """Timeout-less Condition.wait while holding a DIFFERENT lock
+        flags; waiting under only the condition's own lock is the
+        normal protocol (wait releases it) and is exempt, as is a
+        bounded wait."""
+        (tmp_path / "cv.py").write_text(
+            "import threading\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._lk = threading.Lock()\n"
+            "        self._cv = threading.Condition(self._lk)\n"
+            "    def bad(self):\n"
+            "        with self._mu:\n"
+            "            with self._cv:\n"
+            "                self._cv.wait()\n"
+            "    def ok_own_lock(self):\n"
+            "        with self._cv:\n"
+            "            self._cv.wait()\n"
+            "    def ok_bounded(self):\n"
+            "        with self._mu:\n"
+            "            with self._cv:\n"
+            "                self._cv.wait(0.5)\n")
+        model = _analyze(lint, tmp_path)
+        hits = [f for f in model.findings
+                if f.rule == "blocking-under-lock"]
+        assert len(hits) == 1, model.findings
+        assert hits[0].context == "W.bad"
+        assert "_mu" in hits[0].message
+
+    def test_queue_and_join_under_lock(self, lint, tmp_path):
+        (tmp_path / "q.py").write_text(
+            "import queue\n"
+            "import threading\n"
+            "class Q:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._q = queue.Queue()\n"
+            "    def bad_get(self):\n"
+            "        with self._mu:\n"
+            "            return self._q.get()\n"
+            "    def ok_get(self):\n"
+            "        with self._mu:\n"
+            "            return self._q.get(timeout=0.1)\n"
+            "    def ok_unlocked(self):\n"
+            "        return self._q.get()\n"
+            "    def bad_join(self, t):\n"
+            "        with self._mu:\n"
+            "            t.join()\n"
+            "    def ok_join(self, t):\n"
+            "        with self._mu:\n"
+            "            t.join(1.0)\n"
+            "    def ok_str_join(self, parts):\n"
+            "        with self._mu:\n"
+            "            return ','.join(parts)\n")
+        model = _analyze(lint, tmp_path)
+        hits = sorted(f.context for f in model.findings
+                      if f.rule == "blocking-under-lock")
+        assert hits == ["Q.bad_get", "Q.bad_join"], model.findings
+
+    def test_blocking_reached_through_call_chain(self, lint, tmp_path):
+        """The rule is interprocedural: the lock holder never blocks
+        directly, its callee does."""
+        (tmp_path / "chain.py").write_text(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self, t):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._t = t\n"
+            "    def _drain(self):\n"
+            "        self._t.join()\n"
+            "    def stop(self):\n"
+            "        with self._mu:\n"
+            "            self._drain()\n")
+        model = _analyze(lint, tmp_path)
+        hits = [f for f in model.findings
+                if f.rule == "blocking-under-lock"]
+        assert any(f.context == "C.stop" and "chain" in f.message
+                   for f in hits), model.findings
+
+
+# ---------------------------------------------------------------------------
+# worker isolation
+# ---------------------------------------------------------------------------
+
+class TestWorkerIsolation:
+    SRC = (
+        "import threading\n"
+        "class Sched:\n"
+        "    def open_session(self, sid):\n"
+        "        return sid\n"
+        "    def steal(self):\n"
+        "        return 1\n"
+        "class FleetWorker:\n"
+        "    def __init__(self, wid: str):\n"
+        "        self.id = wid\n"
+        "        self.alive = True\n"
+        "        self.stats = {}\n"
+        "        self.scheduler = Sched()\n"
+        "    def local_use(self):\n"
+        "        return self.stats\n"
+        "class Boss:\n"
+        "    def ok_surface(self, w: FleetWorker):\n"
+        "        return w.id if w.alive else None\n"
+        "    def ok_via(self, w: FleetWorker, sid):\n"
+        "        return w.scheduler.open_session(sid)\n"
+        "    def bad_owned(self, w: FleetWorker):\n"
+        "        return w.stats\n"
+        "    def bad_via(self, w: FleetWorker):\n"
+        "        return w.scheduler.steal()\n")
+
+    def test_cross_worker_reach(self, lint, tmp_path):
+        (tmp_path / "iso.py").write_text(self.SRC)
+        model = _analyze(lint, tmp_path)
+        hits = sorted(f.context for f in model.findings
+                      if f.rule == "worker-isolation")
+        assert hits == ["Boss.bad_owned", "Boss.bad_via"], model.findings
+
+    def test_messages_name_the_policy(self, lint, tmp_path):
+        (tmp_path / "iso.py").write_text(self.SRC)
+        model = _analyze(lint, tmp_path)
+        by_ctx = {f.context: f.message for f in model.findings
+                  if f.rule == "worker-isolation"}
+        assert "owned mutable state" in by_ctx["Boss.bad_owned"]
+        assert "only admits" in by_ctx["Boss.bad_via"]
+
+
+# ---------------------------------------------------------------------------
+# allowlist policy
+# ---------------------------------------------------------------------------
+
+class TestAllowlist:
+    def test_edge_declarations_parse(self, lint, tmp_path):
+        p = tmp_path / "allow.txt"
+        p.write_text(
+            "edge::a.py:X -> b.py:Y  # witness-proven under soak\n"
+            "m.py::worker-isolation::C.f  # vetted because reasons\n")
+        entries, declared = lint.load_allowlist(str(p))
+        assert declared == [("a.py:X", "b.py:Y")]
+        assert entries == {("m.py", "worker-isolation", "C.f"):
+                           "vetted because reasons"}
+
+    def test_justification_required(self, lint, tmp_path):
+        for line in ("edge::a.py:X -> b.py:Y\n",
+                     "m.py::worker-isolation::C.f\n",
+                     "edge::a.py:X  # malformed, no arrow\n"):
+            p = tmp_path / "bad.txt"
+            p.write_text(line)
+            with pytest.raises(SystemExit):
+                lint.load_allowlist(str(p))
+
+    def test_stale_entry_fails_the_run(self, lint, tmp_path, capsys):
+        src = tmp_path / "clean.py"
+        src.write_text("x = 1\n")
+        allow = tmp_path / "allow.txt"
+        allow.write_text("gone.py::worker-isolation::dead  # old\n")
+        assert lint.main([str(src), "--allowlist", str(allow)]) == 1
+        assert "STALE" in capsys.readouterr().out
+        allow.write_text("")
+        assert lint.main([str(src), "--allowlist", str(allow)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# the real tree
+# ---------------------------------------------------------------------------
+
+class TestTree:
+    def test_tree_clean_under_allowlist(self, lint):
+        """The premerge contract: zero unsuppressed findings, zero
+        stale allowlist entries over spark_rapids_tpu/."""
+        assert lint.main([]) == 0
+
+    def test_static_graph_vocabulary(self, lint):
+        """The JSON the runtime witness loads: every lock maps to a
+        `rel:line` construction site, known edges are present, and the
+        graph is acyclic."""
+        g = lint.build_graph_json(repo_root=ROOT)
+        fleet = "spark_rapids_tpu/serving/fleet.py:FleetScheduler._lock"
+        assert fleet in g["locks"]
+        rel, _, line = g["locks"][fleet].rpartition(":")
+        assert rel == "spark_rapids_tpu/serving/fleet.py"
+        assert line.isdigit()
+        edges = {tuple(e) for e in g["edges"]}
+        sched = ("spark_rapids_tpu/serving/scheduler.py:"
+                 "ServingScheduler._lock")
+        assert (fleet, sched) in edges
+        # fleet holds its lock while finishing tickets (_fail/done)
+        assert (fleet, "spark_rapids_tpu/serving/fleet.py:"
+                       "FleetTicket._lock") in edges
+        # acyclic: DFS three-color over the full edge set
+        adj = {}
+        for a, b in edges:
+            adj.setdefault(a, []).append(b)
+        state = {}
+
+        def visit(n):
+            state[n] = 1
+            for m in adj.get(n, ()):
+                if state.get(m) == 1:
+                    return False
+                if state.get(m) is None and not visit(m):
+                    return False
+            state[n] = 2
+            return True
+
+        assert all(visit(n) for n in list(adj) if state.get(n) is None)
+
+
+# ---------------------------------------------------------------------------
+# lint_hazards: Condition counts structurally for lock-discipline
+# ---------------------------------------------------------------------------
+
+class TestHazardsConditionExtension:
+    def test_condition_guard_is_locked_evidence(self, tmp_path):
+        """`with self._cv:` where `_cv = threading.Condition(self._lock)`
+        is the same sync object as the lock — mutating an attribute
+        under it and elsewhere without it is inconsistent discipline,
+        whatever the condition is named (the old name heuristic only
+        caught `_lock`-ish names)."""
+        hz = _load_tool("lint_hazards")
+        f = tmp_path / "cvmod.py"
+        f.write_text(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._cv = threading.Condition(self._lock)\n"
+            "        self.items = []\n"
+            "    def add(self, x):\n"
+            "        with self._cv:\n"
+            "            self.items.append(x)\n"
+            "    def drop(self):\n"
+            "        self.items.clear()\n")
+        findings = hz.lint_paths([str(f)], str(tmp_path))
+        hits = [x for x in findings if x.rule == "lock-discipline"]
+        assert len(hits) == 1 and hits[0].context == "C.drop", findings

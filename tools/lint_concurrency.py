@@ -1,0 +1,1252 @@
+#!/usr/bin/env python
+"""Static concurrency linter: lock-order graph, blocking-under-lock,
+worker isolation (docs/analysis.md#concurrency-invariants).
+
+The serving stack is four threaded layers deep — fleet router →
+scheduler dispatchers → worker executors → health/stats/cache — with
+~30 distinct ``threading.Lock``/``RLock``/``Condition`` sites. Their
+ordering and isolation invariants were previously enforced only by
+review; this tool machine-checks them premerge (ci/premerge.sh), the
+way lint_hazards checks JAX hazards:
+
+- ``lock-order-cycle``: the whole-tree LOCK GRAPH — every lock
+  attribute/module lock, keyed per (module, class, attr) like kernel
+  lockdep's lock classes, with an edge A→B wherever B is acquired
+  while A is held, resolved INTERPROCEDURALLY through self-method,
+  typed-attribute, module-function, and constructor calls. Any cycle
+  is a potential deadlock; the finding prints the witness path (which
+  function, which line, through which call chain, closes each edge).
+- ``blocking-under-lock``: an unbounded wait reached while a lock is
+  held — ``Condition``/``Event.wait()`` without timeout, ``.join()``
+  without timeout, ``queue.Queue.get/put`` without timeout,
+  ``.result()`` without timeout, ``PlanExecutor.execute`` — directly
+  or through a call chain. Waiting on a condition while holding ONLY
+  that condition's own lock is exempt (wait releases it); holding any
+  OTHER lock across an unbounded wait stalls every thread that needs
+  it. Bounded waits (timeout slices, ``join(timeout=...)``) pass.
+- ``worker-isolation``: ``FleetWorker``-owned mutable state (executor,
+  health monitor, stats store, the scheduler's cache/queue internals)
+  must only be reached via its owning worker. Outside FleetWorker
+  itself, the router may touch a worker's ``id``/``alive``/
+  ``pressure_score`` and call ``scheduler.open_session/close/metrics/
+  pressure`` — anything else (``w.executor``, ``w.stats``,
+  ``w.health``, ``w.scheduler.cache``, a bare ``w.scheduler`` escaping)
+  is a cross-worker reach. The invalidation bus and the
+  ``peek_frozen``/``adopt`` promotion path are the two sanctioned
+  exceptions, carried in the allowlist with justifications.
+
+The lock graph this tool extracts is also the SHARED EDGE VOCABULARY
+for the runtime lockdep witness (spark_rapids_tpu/runtime/lockdep.py,
+``SPARK_RAPIDS_TPU_LOCKDEP=1``): ``--emit-graph`` dumps
+``{locks: {name: "path:line"}, edges: [[a, b], ...]}`` where the site
+is the lock's construction line, so a dynamically observed
+held→acquired edge maps back to its static prediction and any dynamic
+edge the static graph missed is reported as divergence — the
+interprocedural resolution is empirically auditable. Call targets the
+resolver cannot identify add no edges (an under-approximation, audited
+by exactly that divergence check); edges the analysis cannot derive but
+the witness proves real are declared in the allowlist as::
+
+    edge::<lock-name> -> <lock-name>  # justification
+
+Declared edges join the cycle check (a declared edge completing a
+cycle FAILS) and the emitted graph. Same-name self-edges are excluded
+from the graph on both halves: the only same-class nesting in the tree
+is RLock reentrancy on one instance, and a class-keyed self-edge
+cannot distinguish that from a real two-instance deadlock.
+
+Vetted exceptions live in the allowlist (default
+``tools/lint_concurrency_allowlist.txt``), one per line::
+
+    <repo/relative/path.py>::<rule>::<qualified.context>  # justification
+
+The justification is REQUIRED, and a STALE entry (matching no current
+finding) FAILS the run — same policy as lint_hazards. Usage::
+
+    python tools/lint_concurrency.py [paths...] [--allowlist FILE]
+                                     [--list] [--emit-graph FILE]
+
+Exit status 1 when any unsuppressed finding remains, or any allowlist
+entry has gone stale.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+_LOCK_CTORS = {"Lock", "RLock"}
+_COND_CTOR = "Condition"
+
+# worker-isolation policy: class name -> (plain read surface,
+# {gateway attr: allowed methods through it}, owned mutable state)
+_ISOLATION = {
+    "FleetWorker": {
+        "surface": {"id", "alive", "pressure_score"},
+        "via": {"scheduler": {"open_session", "close", "metrics",
+                              "pressure"}},
+        "owned": {"executor", "stats", "health"},
+    },
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str            # repo-relative posix path
+    line: int
+    context: str         # dotted qualname of the enclosing def/class
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.path, self.rule, self.context)
+
+    def __str__(self):
+        return (f"{self.path}:{self.line}: [{self.rule}] "
+                f"{self.context or '<module>'}: {self.message}")
+
+
+def _dotted(node) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _short(lock_name: str) -> str:
+    """Compact lock name for witness paths / allowlist contexts:
+    'spark_rapids_tpu/serving/fleet.py:FleetScheduler._lock' ->
+    'serving/fleet:FleetScheduler._lock'."""
+    path, _, rest = lock_name.partition(":")
+    if path.endswith(".py"):
+        path = path[:-3]
+    if path.startswith("spark_rapids_tpu/"):
+        path = path[len("spark_rapids_tpu/"):]
+    return f"{path}:{rest}"
+
+
+# ---- model ------------------------------------------------------------------
+
+class LockDecl:
+    """One lock CLASS (lockdep's sense): a (module, owner, attr) slot,
+    not an instance. `site` is the construction line — the dynamic
+    witness keys wrapped locks by construction site, which is how both
+    halves share one vocabulary."""
+
+    def __init__(self, name: str, rel: str, line: int, kind: str):
+        self.name = name
+        self.rel = rel
+        self.line = line
+        self.kind = kind                   # "lock" | "rlock" | "condition"
+
+    @property
+    def site(self) -> str:
+        return f"{self.rel}:{self.line}"
+
+
+class ClassInfo:
+    def __init__(self, rel: str, name: str):
+        self.rel = rel
+        self.name = name
+        self.key = f"{rel}:{name}"
+        self.locks: Dict[str, LockDecl] = {}       # attr -> decl
+        self.aliases: Dict[str, str] = {}          # Condition attr -> lock attr
+        self.attr_types: Dict[str, tuple] = {}     # attr -> TypeRef
+        self.methods: Dict[str, "FuncInfo"] = {}
+
+
+class FuncInfo:
+    def __init__(self, rel: str, qual: str, node, cls: Optional[ClassInfo],
+                 mod: "ModuleInfo"):
+        self.rel = rel
+        self.qual = qual
+        self.node = node
+        self.cls = cls
+        self.mod = mod
+        self.param_types: Dict[str, tuple] = {}
+        self.ret_type: Optional[tuple] = None
+        self.locals_funcs: Dict[str, "FuncInfo"] = {}  # nested defs
+        # filled by the scan pass:
+        self.acquires: Set[str] = set()            # direct lock names
+        self.calls: Set["FuncInfo"] = set()        # every resolved callee
+        self.blocking: List[tuple] = []            # (line, desc, own_lock)
+        self.under: List[tuple] = []   # (held names, line, callee, blockdesc)
+        self.local_edges: List[tuple] = []         # (src, dst, line)
+
+
+class ModuleInfo:
+    def __init__(self, rel: str, tree):
+        self.rel = rel
+        self.tree = tree
+        self.classes: Dict[str, ClassInfo] = {}
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.imports: Dict[str, tuple] = {}   # local -> ("mod",rel)|("sym",rel,name)
+        self.module_locks: Dict[str, LockDecl] = {}
+        self.var_types: Dict[str, tuple] = {}
+
+
+class Model:
+    """Whole-tree index: modules, lock declarations, resolution tables."""
+
+    def __init__(self):
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.classes_by_name: Dict[str, List[ClassInfo]] = {}
+        self.class_by_key: Dict[str, ClassInfo] = {}
+        self.funcs_by_name: Dict[str, List[FuncInfo]] = {}
+        self.methods_by_name: Dict[str, List[FuncInfo]] = {}
+        self.locks: Dict[str, LockDecl] = {}
+        self.findings: List[Finding] = []
+        # edge -> (rel, line, qual, note): first witness wins
+        self.edges: Dict[Tuple[str, str], tuple] = {}
+        self._trans_acq: Dict[int, Set[str]] = {}
+        self._trans_blk: Dict[int, List[tuple]] = {}
+
+    # -- construction ---------------------------------------------------------
+
+    def add_module(self, rel: str, tree) -> ModuleInfo:
+        mod = ModuleInfo(rel, tree)
+        self.modules[rel] = mod
+        return mod
+
+    def index(self):
+        for mod in self.modules.values():
+            for ci in mod.classes.values():
+                self.classes_by_name.setdefault(ci.name, []).append(ci)
+                self.class_by_key[ci.key] = ci
+                for decl in ci.locks.values():
+                    self.locks[decl.name] = decl
+                for fi in ci.methods.values():
+                    self.methods_by_name.setdefault(
+                        fi.node.name, []).append(fi)
+            for fi in mod.funcs.values():
+                self.funcs_by_name.setdefault(fi.node.name, []).append(fi)
+            for decl in mod.module_locks.values():
+                self.locks[decl.name] = decl
+
+    # -- resolution -----------------------------------------------------------
+
+    def resolve_class(self, mod: ModuleInfo, name: str) -> Optional[ClassInfo]:
+        ci = mod.classes.get(name)
+        if ci is not None:
+            return ci
+        imp = mod.imports.get(name)
+        if imp is not None and imp[0] == "sym":
+            target = self.modules.get(imp[1])
+            if target is not None:
+                ci = target.classes.get(imp[2])
+                if ci is not None:
+                    return ci
+        cands = self.classes_by_name.get(name, [])
+        return cands[0] if len(cands) == 1 else None
+
+    def resolve_func(self, mod: ModuleInfo, name: str) -> Optional[FuncInfo]:
+        fi = mod.funcs.get(name)
+        if fi is not None:
+            return fi
+        imp = mod.imports.get(name)
+        if imp is not None and imp[0] == "sym":
+            target = self.modules.get(imp[1])
+            if target is not None:
+                fi = target.funcs.get(imp[2])
+                if fi is not None:
+                    return fi
+        cands = self.funcs_by_name.get(name, [])
+        return cands[0] if len(cands) == 1 else None
+
+    def resolve_in_alias(self, mod: ModuleInfo, alias: str, name: str):
+        """`cache_mod.ResultCache` / `cache_mod.input_digest` through a
+        module import alias: -> ("class", ci) | ("func", fi) | None."""
+        imp = mod.imports.get(alias)
+        if imp is None or imp[0] != "mod":
+            return None
+        target = self.modules.get(imp[1])
+        if target is None:
+            return None
+        if name in target.classes:
+            return ("class", target.classes[name])
+        if name in target.funcs:
+            return ("func", target.funcs[name])
+        return None
+
+    def unique_method(self, name: str) -> Optional[FuncInfo]:
+        cands = self.methods_by_name.get(name, [])
+        return cands[0] if len(cands) == 1 else None
+
+    # -- transitive closures --------------------------------------------------
+
+    def trans_acquired(self, fi: FuncInfo,
+                       _stack: Optional[Set[int]] = None) -> Set[str]:
+        key = id(fi)
+        if key in self._trans_acq:
+            return self._trans_acq[key]
+        stack = _stack if _stack is not None else set()
+        if key in stack:
+            return set()                   # recursion: already counted above
+        stack.add(key)
+        out = set(fi.acquires)
+        for callee in fi.calls:
+            out |= self.trans_acquired(callee, stack)
+        stack.discard(key)
+        self._trans_acq[key] = out
+        return out
+
+    def trans_blocking(self, fi: FuncInfo,
+                       _stack: Optional[Set[int]] = None) -> List[tuple]:
+        """[(desc, own_lock, chain)] reachable from fi; chain names the
+        call path for the witness message."""
+        key = id(fi)
+        if key in self._trans_blk:
+            return self._trans_blk[key]
+        stack = _stack if _stack is not None else set()
+        if key in stack:
+            return []
+        stack.add(key)
+        out = [(desc, own, f"{fi.qual}:{line}")
+               for line, desc, own in fi.blocking]
+        for callee in fi.calls:
+            for desc, own, chain in self.trans_blocking(callee, stack):
+                out.append((desc, own, f"{fi.qual} -> {chain}"))
+        stack.discard(key)
+        # one entry per distinct desc keeps messages bounded
+        seen, uniq = set(), []
+        for desc, own, chain in out:
+            if desc not in seen:
+                seen.add(desc)
+                uniq.append((desc, own, chain))
+        self._trans_blk[key] = uniq
+        return uniq
+
+    def add_edge(self, src: str, dst: str, rel: str, line: int,
+                 qual: str, note: str):
+        if src == dst:
+            return                         # same-class policy: see docstring
+        self.edges.setdefault((src, dst), (rel, line, qual, note))
+
+
+# ---- pass 1: collect modules, classes, locks, types -------------------------
+
+def _module_rel(modules: Dict[str, ModuleInfo], cur_rel: str,
+                node: ast.ImportFrom, name: str) -> Optional[str]:
+    """Repo-relative path of the module `name` is imported from (or the
+    submodule `name` itself, for `from . import name`)."""
+    if node.level:
+        base = cur_rel.split("/")[:-1]
+        up = node.level - 1
+        if up:
+            base = base[:-up] if up <= len(base) else []
+        parts = base + (node.module.split(".") if node.module else [])
+    else:
+        if not node.module or not node.module.startswith("spark_rapids_tpu"):
+            return None
+        parts = node.module.split(".")
+    for cand in ("/".join(parts + [name]) + ".py",
+                 "/".join(parts + [name, "__init__.py"])):
+        if cand in modules:
+            return ("submodule", cand)
+    for cand in ("/".join(parts) + ".py",
+                 "/".join(parts) + "/__init__.py"):
+        if cand in modules:
+            return ("from", cand)
+    return None
+
+
+def _collect_imports(model: Model, mod: ModuleInfo):
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                local = alias.asname or alias.name
+                hit = _module_rel(model.modules, mod.rel, node, alias.name)
+                if hit is None:
+                    continue
+                kind, rel = hit
+                if kind == "submodule":
+                    mod.imports[local] = ("mod", rel)
+                else:
+                    mod.imports[local] = ("sym", rel, alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if not alias.name.startswith("spark_rapids_tpu"):
+                    continue
+                local = alias.asname or alias.name.split(".")[0]
+                for cand in (alias.name.replace(".", "/") + ".py",
+                             alias.name.replace(".", "/") + "/__init__.py"):
+                    if cand in model.modules:
+                        mod.imports[local] = ("mod", cand)
+                        break
+
+
+def _lock_ctor_kind(value) -> Optional[str]:
+    """'lock'/'rlock'/'condition' when `value` constructs one."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = _dotted(value.func).rsplit(".", 1)[-1]
+    if name in _LOCK_CTORS:
+        return "rlock" if name == "RLock" else "lock"
+    if name == _COND_CTOR:
+        return "condition"
+    return None
+
+
+def _collect_module(model: Model, mod: ModuleInfo):
+    """Classes, module functions, module-level locks and var types."""
+    for node in mod.tree.body:
+        if isinstance(node, ast.ClassDef):
+            ci = ClassInfo(mod.rel, node.name)
+            mod.classes[node.name] = ci
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fi = FuncInfo(mod.rel, f"{node.name}.{item.name}",
+                                  item, ci, mod)
+                    ci.methods[item.name] = fi
+                elif isinstance(item, ast.Assign) and len(item.targets) == 1 \
+                        and isinstance(item.targets[0], ast.Name):
+                    kind = _lock_ctor_kind(item.value)
+                    attr = item.targets[0].id
+                    if kind in ("lock", "rlock"):
+                        ci.locks[attr] = LockDecl(
+                            f"{mod.rel}:{node.name}.{attr}", mod.rel,
+                            item.value.lineno, kind)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mod.funcs[node.name] = FuncInfo(mod.rel, node.name, node,
+                                            None, mod)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            kind = _lock_ctor_kind(node.value)
+            if kind in ("lock", "rlock"):
+                mod.module_locks[name] = LockDecl(
+                    f"{mod.rel}:{name}", mod.rel, node.value.lineno, kind)
+            elif isinstance(node.value, ast.Call):
+                mod.var_types[name] = ("ctor", node.value)  # resolved later
+    # nested defs inside functions (thread bodies, closures)
+    for fi in list(mod.funcs.values()) + [
+            m for c in mod.classes.values() for m in c.methods.values()]:
+        for sub in ast.walk(fi.node):
+            if sub is not fi.node and isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child = FuncInfo(mod.rel, f"{fi.qual}.<locals>.{sub.name}",
+                                 sub, fi.cls, mod)
+                fi.locals_funcs[sub.name] = child
+
+
+def _collect_class_attrs(model: Model, mod: ModuleInfo, ci: ClassInfo):
+    """Lock attrs, Condition aliases, and attribute types from every
+    `self.X = ...` in the class body (any method, not just __init__)."""
+    for fi in ci.methods.values():
+        for node in ast.walk(fi.node):
+            if not (isinstance(node, (ast.Assign, ast.AnnAssign))):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            value = node.value
+            for tgt in targets:
+                if not (isinstance(tgt, ast.Attribute) and
+                        isinstance(tgt.value, ast.Name) and
+                        tgt.value.id == "self"):
+                    continue
+                attr = tgt.attr
+                kind = _lock_ctor_kind(value)
+                if kind in ("lock", "rlock"):
+                    ci.locks.setdefault(attr, LockDecl(
+                        f"{ci.rel}:{ci.name}.{attr}", ci.rel,
+                        value.lineno, kind))
+                    continue
+                if kind == "condition":
+                    arg = value.args[0] if value.args else None
+                    if isinstance(arg, ast.Attribute) and \
+                            isinstance(arg.value, ast.Name) and \
+                            arg.value.id == "self":
+                        ci.aliases[attr] = arg.attr
+                    else:
+                        # bare Condition(): its own (internal) lock
+                        ci.locks.setdefault(attr, LockDecl(
+                            f"{ci.rel}:{ci.name}.{attr}", ci.rel,
+                            value.lineno, "condition"))
+                    continue
+                t = None
+                if value is not None:
+                    t = _value_type(model, mod, ci, value)
+                if t is None and isinstance(node, ast.AnnAssign):
+                    t = _ann_type(model, mod, node.annotation)
+                if t is not None:
+                    ci.attr_types.setdefault(attr, t)
+
+
+def _ann_type(model: Model, mod: ModuleInfo, ann) -> Optional[tuple]:
+    """TypeRef from an annotation: ('class', ClassInfo) | ('seq', T) |
+    ('map', T) | ('queue',)."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(ann, ast.Name):
+        ci = model.resolve_class(mod, ann.id)
+        return ("class", ci) if ci is not None else None
+    if isinstance(ann, ast.Attribute):
+        if _dotted(ann) == "queue.Queue":
+            return ("queue",)
+        ci = model.resolve_class(mod, ann.attr)
+        return ("class", ci) if ci is not None else None
+    if isinstance(ann, ast.Subscript):
+        base = _dotted(ann.value).rsplit(".", 1)[-1]
+        sl = ann.slice
+        if base in ("List", "Set", "FrozenSet", "Sequence", "Iterable",
+                    "Iterator", "Tuple", "list", "set", "tuple"):
+            elt = sl.elts[0] if isinstance(sl, ast.Tuple) and sl.elts else sl
+            t = _ann_type(model, mod, elt)
+            return ("seq", t) if t is not None else None
+        if base in ("Dict", "Mapping", "MutableMapping", "dict"):
+            if isinstance(sl, ast.Tuple) and len(sl.elts) == 2:
+                t = _ann_type(model, mod, sl.elts[1])
+                return ("map", t) if t is not None else None
+            return None
+        if base == "Optional":
+            return _ann_type(model, mod, sl)
+    return None
+
+
+def _value_type(model: Model, mod: ModuleInfo, ci: Optional[ClassInfo],
+                value) -> Optional[tuple]:
+    """TypeRef of a constructor-call value (no local env — used for
+    attribute assignments): `Foo(...)`, `mod_alias.Foo(...)`,
+    `queue.Queue(...)`."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    if isinstance(f, ast.Name):
+        target = model.resolve_class(mod, f.id)
+        if target is not None:
+            return ("class", target)
+    elif isinstance(f, ast.Attribute):
+        if _dotted(f) == "queue.Queue":
+            return ("queue",)
+        if isinstance(f.value, ast.Name):
+            hit = model.resolve_in_alias(mod, f.value.id, f.attr)
+            if hit is not None and hit[0] == "class":
+                return ("class", hit[1])
+    return None
+
+
+# ---- pass 2: per-function scan ----------------------------------------------
+
+class _FuncScanner:
+    """One function's walk: tracks the held-lock stack through `with`
+    regions and a forward-only local type environment, recording direct
+    acquisitions, resolved calls, blocking ops, and isolation reaches."""
+
+    _SEQ_CTORS = {"list", "sorted", "set", "tuple", "frozenset", "reversed"}
+    _ELEM_PICKERS = {"min", "max", "next"}
+
+    def __init__(self, model: Model, fi: FuncInfo):
+        self.model = model
+        self.fi = fi
+        self.env: Dict[str, tuple] = dict(fi.param_types)
+        if fi.cls is not None:
+            self.env.setdefault("self", ("class", fi.cls))
+
+    def run(self):
+        node = self.fi.node
+        self._scan_stmts(node.body, [])
+
+    # -- type environment -----------------------------------------------------
+
+    def _type_of(self, expr) -> Optional[tuple]:
+        model, mod = self.model, self.fi.mod
+        if isinstance(expr, ast.Name):
+            t = self.env.get(expr.id)
+            if t is not None:
+                return t
+            vt = mod.var_types.get(expr.id)
+            if vt is not None and vt[0] == "ctor":
+                return _value_type(model, mod, self.fi.cls, vt[1])
+            return None
+        if isinstance(expr, ast.Attribute):
+            base_t = self._type_of(expr.value)
+            if base_t is not None and base_t[0] == "class":
+                return base_t[1].attr_types.get(expr.attr)
+            return None
+        if isinstance(expr, ast.Subscript):
+            base_t = self._type_of(expr.value)
+            if base_t is not None and base_t[0] in ("seq", "map"):
+                return base_t[1]
+            return None
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            saved = dict(self.env)
+            try:
+                for comp in expr.generators:
+                    self._bind_for_target(comp.target, comp.iter)
+                t = self._type_of(expr.elt)
+            finally:
+                self.env = saved
+            return ("seq", t) if t is not None else None
+        if isinstance(expr, ast.IfExp):
+            return self._type_of(expr.body) or self._type_of(expr.orelse)
+        if isinstance(expr, ast.Call):
+            return self._call_type(expr)
+        return None
+
+    def _call_type(self, call) -> Optional[tuple]:
+        model, mod = self.model, self.fi.mod
+        f = call.func
+        if isinstance(f, ast.Name):
+            ci = model.resolve_class(mod, f.id)
+            if ci is not None:
+                return ("class", ci)
+            if f.id in self._SEQ_CTORS and call.args:
+                t = self._type_of(call.args[0])
+                return t if t is not None and t[0] == "seq" else None
+            if f.id in self._ELEM_PICKERS and call.args:
+                t = self._type_of(call.args[0])
+                if t is not None and t[0] == "seq":
+                    return t[1]
+                return None
+            fn = model.resolve_func(mod, f.id)
+            if fn is not None:
+                return fn.ret_type
+            return None
+        if isinstance(f, ast.Attribute):
+            if _dotted(f) == "queue.Queue":
+                return ("queue",)
+            base_t = self._type_of(f.value)
+            if base_t is not None:
+                if base_t[0] == "map" and f.attr in ("get", "pop",
+                                                     "setdefault"):
+                    return base_t[1]
+                if base_t[0] == "map" and f.attr == "values":
+                    return ("seq", base_t[1])
+                if base_t[0] == "class":
+                    meth = base_t[1].methods.get(f.attr)
+                    if meth is not None:
+                        return meth.ret_type
+            if isinstance(f.value, ast.Name):
+                hit = model.resolve_in_alias(mod, f.value.id, f.attr)
+                if hit is not None and hit[0] == "class":
+                    return ("class", hit[1])
+        return None
+
+    def _bind_for_target(self, target, iter_expr):
+        t = self._type_of(iter_expr)
+        if isinstance(target, ast.Name) and t is not None and t[0] == "seq":
+            self.env[target.id] = t[1]
+
+    # -- lock identification --------------------------------------------------
+
+    def _lock_of(self, expr) -> Optional[LockDecl]:
+        cls = self.fi.cls
+        if isinstance(expr, ast.Name):
+            return self.fi.mod.module_locks.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                    and cls is not None:
+                attr = cls.aliases.get(expr.attr, expr.attr)
+                return cls.locks.get(attr)
+            base_t = self._type_of(expr.value)
+            if base_t is not None and base_t[0] == "class":
+                owner = base_t[1]
+                attr = owner.aliases.get(expr.attr, expr.attr)
+                return owner.locks.get(attr)
+        return None
+
+    # -- call resolution ------------------------------------------------------
+
+    def _resolve_call(self, call) -> Optional[FuncInfo]:
+        model, mod, fi = self.model, self.fi.mod, self.fi
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in fi.locals_funcs:
+                return fi.locals_funcs[f.id]
+            ci = model.resolve_class(mod, f.id)
+            if ci is not None:
+                return ci.methods.get("__init__")
+            return model.resolve_func(mod, f.id)
+        if isinstance(f, ast.Attribute):
+            meth = f.attr
+            base = f.value
+            if isinstance(base, ast.Name) and base.id == "self" and \
+                    fi.cls is not None and meth in fi.cls.methods:
+                return fi.cls.methods[meth]
+            base_t = self._type_of(base)
+            if base_t is not None and base_t[0] == "class":
+                hit = base_t[1].methods.get(meth)
+                if hit is not None:
+                    return hit
+            if isinstance(base, ast.Name):
+                hit = model.resolve_in_alias(mod, base.id, meth)
+                if hit is not None:
+                    return (hit[1].methods.get("__init__")
+                            if hit[0] == "class" else hit[1])
+            if base_t is None:
+                # unique-name fallback: sound only because a wrong pick
+                # is audited by the dynamic witness divergence check
+                return model.unique_method(meth)
+        return None
+
+    # -- blocking classification ----------------------------------------------
+
+    def _blocking_desc(self, call) -> Optional[Tuple[str, Optional[str]]]:
+        """(description, own-lock-name) when `call` is an unbounded
+        blocking op. own-lock is the condition's underlying lock for
+        `.wait()` (exempt when it is the only lock held)."""
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            return None
+        name = f.attr
+        kwargs = {k.arg for k in call.keywords}
+        bounded = "timeout" in kwargs or bool(call.args)
+        if name == "wait" and not bounded:
+            own = self._lock_of(f.value)
+            return ("wait() without timeout", own.name if own else None)
+        if name == "join" and not bounded and not call.args:
+            return ("join() without timeout", None)
+        if name == "result" and not bounded:
+            return ("result() without timeout", None)
+        if name in ("get", "put"):
+            t = self._type_of(f.value)
+            if t == ("queue",):
+                if "timeout" in kwargs or "block" in kwargs:
+                    return None
+                return (f"queue.Queue.{name}() without timeout", None)
+            return None
+        if name == "execute":
+            t = self._type_of(f.value)
+            is_exec = (t is not None and t[0] == "class" and
+                       t[1].name == "PlanExecutor")
+            if not is_exec and isinstance(f.value, ast.Attribute):
+                is_exec = f.value.attr == "executor"
+            if is_exec:
+                return ("PlanExecutor.execute (whole-plan execution)", None)
+        return None
+
+    # -- statement walk -------------------------------------------------------
+
+    def _scan_stmts(self, stmts, held: List[LockDecl]):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                entered = 0
+                for item in st.items:
+                    decl = self._lock_of(item.context_expr)
+                    if decl is not None:
+                        for h in held:
+                            self.model.add_edge(
+                                h.name, decl.name, self.fi.rel,
+                                item.context_expr.lineno, self.fi.qual,
+                                "nested with")
+                        self.fi.acquires.add(decl.name)
+                        held.append(decl)
+                        entered += 1
+                    else:
+                        self._walk_expr(item.context_expr, held)
+                self._scan_stmts(st.body, held)
+                for _ in range(entered):
+                    held.pop()
+                continue
+            if isinstance(st, ast.Assign):
+                self._walk_expr(st.value, held)
+                if len(st.targets) == 1 and isinstance(st.targets[0],
+                                                       ast.Name):
+                    t = self._type_of(st.value)
+                    if t is not None:
+                        self.env[st.targets[0].id] = t
+                for tgt in st.targets:
+                    self._walk_expr(tgt, held)
+                continue
+            if isinstance(st, ast.AnnAssign):
+                if st.value is not None:
+                    self._walk_expr(st.value, held)
+                if isinstance(st.target, ast.Name):
+                    t = (self._type_of(st.value) if st.value is not None
+                         else None) or _ann_type(self.model, self.fi.mod,
+                                                 st.annotation)
+                    if t is not None:
+                        self.env[st.target.id] = t
+                self._walk_expr(st.target, held)
+                continue
+            if isinstance(st, (ast.For, ast.AsyncFor)):
+                self._walk_expr(st.iter, held)
+                self._bind_for_target(st.target, st.iter)
+                self._scan_stmts(st.body, held)
+                self._scan_stmts(st.orelse, held)
+                continue
+            if isinstance(st, ast.If):
+                self._walk_expr(st.test, held)
+                self._scan_stmts(st.body, held)
+                self._scan_stmts(st.orelse, held)
+                continue
+            if isinstance(st, ast.While):
+                self._walk_expr(st.test, held)
+                self._scan_stmts(st.body, held)
+                self._scan_stmts(st.orelse, held)
+                continue
+            if isinstance(st, ast.Try):
+                self._scan_stmts(st.body, held)
+                for h in st.handlers:
+                    self._scan_stmts(h.body, held)
+                self._scan_stmts(st.orelse, held)
+                self._scan_stmts(st.finalbody, held)
+                continue
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self._walk_expr(child, held)
+
+    # -- expression walk ------------------------------------------------------
+
+    def _walk_expr(self, expr, held: List[LockDecl]):
+        if expr is None:
+            return
+        if isinstance(expr, ast.Call):
+            self._handle_call(expr, held)
+            return
+        if isinstance(expr, ast.Attribute):
+            self._check_isolation(expr)
+            node = expr.value
+            while isinstance(node, ast.Attribute):
+                node = node.value          # the chain was checked whole
+            self._walk_expr(node, held)
+            return
+        if isinstance(expr, ast.Lambda):
+            self._walk_expr(expr.body, held)
+            return
+        if isinstance(expr, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                self._walk_expr(child, held)
+            elif isinstance(child, ast.comprehension):
+                self._walk_expr(child.iter, held)
+                for cond in child.ifs:
+                    self._walk_expr(cond, held)
+
+    def _handle_call(self, call, held: List[LockDecl]):
+        callee = self._resolve_call(call)
+        block = self._blocking_desc(call)
+        if callee is not None:
+            self.fi.calls.add(callee)
+        if block is not None:
+            self.fi.blocking.append((call.lineno, block[0], block[1]))
+        if held:
+            self.fi.under.append((tuple(h.name for h in held), call.lineno,
+                                  callee, block))
+        if isinstance(call.func, ast.Attribute):
+            self._check_isolation(call.func)
+            node = call.func.value
+            while isinstance(node, ast.Attribute):
+                node = node.value          # the chain was checked whole
+            self._walk_expr(node, held)
+        elif not isinstance(call.func, ast.Name):
+            self._walk_expr(call.func, held)
+        # lambda args: min/max/sorted/filter/map key functions see the
+        # sequence's element type
+        elem = None
+        fname = call.func.id if isinstance(call.func, ast.Name) else ""
+        if fname in ("min", "max", "sorted", "filter", "map") and call.args:
+            for a in call.args:
+                t = self._type_of(a)
+                if t is not None and t[0] == "seq":
+                    elem = t[1]
+                    break
+        for a in list(call.args) + [k.value for k in call.keywords]:
+            if isinstance(a, ast.Lambda) and elem is not None:
+                saved = dict(self.env)
+                for p in a.args.args:
+                    self.env[p.arg] = elem
+                self._walk_expr(a.body, held)
+                self.env = saved
+            else:
+                self._walk_expr(a, held)
+
+    # -- worker isolation -----------------------------------------------------
+
+    def _check_isolation(self, attr_node):
+        """Unrolls the full attribute chain once (callers recurse only
+        into the base) and applies the FleetWorker reach policy."""
+        attrs: List[str] = []
+        node = attr_node
+        while isinstance(node, ast.Attribute):
+            attrs.append(node.attr)
+            node = node.value
+        attrs.reverse()
+        base_t = self._type_of(node)
+        if base_t is None or base_t[0] != "class":
+            return
+        policy = _ISOLATION.get(base_t[1].name)
+        if policy is None:
+            return
+        if self.fi.cls is not None and self.fi.cls.name == base_t[1].name:
+            return                          # the worker touching itself
+        head = attrs[0]
+        if head in policy["surface"]:
+            return
+        if head in policy["via"]:
+            if len(attrs) >= 2 and attrs[1] in policy["via"][head]:
+                return
+            reach = ".".join(attrs)
+            self.model.findings.append(Finding(
+                "worker-isolation", self.fi.rel, attr_node.lineno,
+                self.fi.qual,
+                f"reaches worker-internal state `{reach}` — "
+                f"{base_t[1].name}.{head} only admits "
+                f"{sorted(policy['via'][head])} from outside the worker"))
+            return
+        if head in policy["owned"]:
+            reach = ".".join(attrs)
+            self.model.findings.append(Finding(
+                "worker-isolation", self.fi.rel, attr_node.lineno,
+                self.fi.qual,
+                f"reaches {base_t[1].name}-owned mutable state `{reach}` "
+                f"outside the owning worker (allowed surface: "
+                f"{sorted(policy['surface'])})"))
+
+
+# ---- pass 3: interprocedural edges + blocking findings ----------------------
+
+def _finalize(model: Model):
+    all_funcs: List[FuncInfo] = []
+    for mod in model.modules.values():
+        all_funcs.extend(mod.funcs.values())
+        for ci in mod.classes.values():
+            all_funcs.extend(ci.methods.values())
+        for fi in list(all_funcs):
+            all_funcs.extend(fi.locals_funcs.values())
+    # dedupe (locals may be reachable from two lists)
+    seen: Set[int] = set()
+    funcs = []
+    for fi in all_funcs:
+        if id(fi) not in seen:
+            seen.add(id(fi))
+            funcs.append(fi)
+
+    for fi in funcs:
+        for held_names, line, callee, block in fi.under:
+            if callee is not None:
+                for dst in model.trans_acquired(callee):
+                    for src in held_names:
+                        model.add_edge(src, dst, fi.rel, line, fi.qual,
+                                       f"via {callee.qual}")
+            # blocking at the call site itself
+            if block is not None:
+                desc, own = block
+                others = [h for h in held_names if h != own]
+                if others:
+                    model.findings.append(Finding(
+                        "blocking-under-lock", fi.rel, line, fi.qual,
+                        f"{desc} while holding "
+                        f"{', '.join(_short(h) for h in others)}"))
+            elif callee is not None:
+                for desc, own, chain in model.trans_blocking(callee):
+                    others = [h for h in held_names if h != own]
+                    if others:
+                        model.findings.append(Finding(
+                            "blocking-under-lock", fi.rel, line, fi.qual,
+                            f"call chain reaches {desc} "
+                            f"({chain}) while holding "
+                            f"{', '.join(_short(h) for h in others)}"))
+
+
+def _find_cycles(model: Model, declared: List[Tuple[str, str]]):
+    adj: Dict[str, Dict[str, tuple]] = {}
+    for (src, dst), wit in model.edges.items():
+        adj.setdefault(src, {})[dst] = wit
+    for src, dst in declared:
+        if src != dst:
+            adj.setdefault(src, {}).setdefault(
+                dst, ("<allowlist>", 0, "declared-edge", "declared"))
+
+    index_counter = [0]
+    stack: List[str] = []
+    on_stack: Set[str] = set()
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    sccs: List[List[str]] = []
+
+    def strongconnect(v: str):
+        work = [(v, iter(sorted(adj.get(v, {}))))]
+        index[v] = low[v] = index_counter[0]
+        index_counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = index_counter[0]
+                    index_counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, {})))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(comp)
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+
+    for comp in sccs:
+        comp_set = set(comp)
+        start = min(comp)
+        # walk one concrete cycle inside the SCC for the witness
+        path = [start]
+        cur = start
+        while True:
+            nxt = min(d for d in adj.get(cur, {}) if d in comp_set)
+            if nxt == start or nxt in path:
+                path.append(nxt)
+                break
+            path.append(nxt)
+            cur = nxt
+        lines = []
+        for a, b in zip(path, path[1:]):
+            rel, line, qual, note = adj[a][b]
+            lines.append(f"{_short(a)} -> {_short(b)} "
+                         f"[{qual} at {rel}:{line}, {note}]")
+        first = adj[path[0]][path[1]]
+        model.findings.append(Finding(
+            "lock-order-cycle", first[0], first[1],
+            " -> ".join(_short(n) for n in path),
+            "lock-order cycle (potential deadlock): " + "; ".join(lines)))
+
+
+# ---- driver -----------------------------------------------------------------
+
+def build_model(paths: List[str], repo_root: str) -> Model:
+    model = Model()
+    files: List[str] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            files.append(p)
+        else:
+            for dirpath, _, names in os.walk(p):
+                files.extend(os.path.join(dirpath, n)
+                             for n in sorted(names) if n.endswith(".py"))
+    parsed = []
+    for path in sorted(files):
+        rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
+        with open(path, "rb") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            model.findings.append(Finding("parse-error", rel,
+                                          e.lineno or 0, "", str(e)))
+            continue
+        parsed.append(model.add_module(rel, tree))
+    for mod in parsed:
+        _collect_module(model, mod)
+    for mod in parsed:
+        _collect_imports(model, mod)
+    model.index()
+    for mod in parsed:
+        for ci in mod.classes.values():
+            _collect_class_attrs(model, mod, ci)
+            for decl in ci.locks.values():
+                model.locks[decl.name] = decl
+    # param/return annotations need classes indexed first
+    for mod in parsed:
+        every = list(mod.funcs.values()) + [
+            m for c in mod.classes.values() for m in c.methods.values()]
+        for fi in every:
+            every.extend(fi.locals_funcs.values())
+        for fi in every:
+            args = fi.node.args
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                t = _ann_type(model, mod, a.annotation)
+                if t is not None:
+                    fi.param_types[a.arg] = t
+            fi.ret_type = _ann_type(model, mod, fi.node.returns)
+    # `self.X = param` propagates the parameter's annotated type to the
+    # attribute (e.g. SpillableBuffer.__init__'s `self._pool = pool`).
+    # Ctor-call values were typed in _collect_class_attrs, but that pass
+    # runs before parameter annotations resolve.
+    for mod in parsed:
+        for ci in mod.classes.values():
+            for fi in ci.methods.values():
+                for node in ast.walk(fi.node):
+                    if not (isinstance(node, ast.Assign) and
+                            isinstance(node.value, ast.Name)):
+                        continue
+                    t = fi.param_types.get(node.value.id)
+                    if t is None:
+                        continue
+                    for tgt in node.targets:
+                        if (isinstance(tgt, ast.Attribute) and
+                                isinstance(tgt.value, ast.Name) and
+                                tgt.value.id == "self" and
+                                tgt.attr not in ci.locks and
+                                tgt.attr not in ci.aliases):
+                            ci.attr_types.setdefault(tgt.attr, t)
+    for mod in parsed:
+        every = list(mod.funcs.values()) + [
+            m for c in mod.classes.values() for m in c.methods.values()]
+        for fi in every:
+            every.extend(fi.locals_funcs.values())
+        for fi in every:
+            _FuncScanner(model, fi).run()
+    _finalize(model)
+    return model
+
+
+def load_allowlist(path: str):
+    """-> ({(path, rule, context): justification}, [(src, dst)] declared
+    edges). Every entry REQUIRES a non-empty `# justification`."""
+    out: Dict[Tuple[str, str, str], str] = {}
+    declared: List[Tuple[str, str]] = []
+    if not os.path.exists(path):
+        return out, declared
+    with open(path) as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            entry, _, just = line.partition("#")
+            just = just.strip()
+            fields = [p.strip() for p in entry.strip().split("::")]
+            if not just:
+                raise SystemExit(
+                    f"{path}:{lineno}: allowlist entry has no "
+                    "justification — every vetted exception must say why")
+            if len(fields) == 2 and fields[0] == "edge":
+                src, sep, dst = fields[1].partition("->")
+                if not sep or not src.strip() or not dst.strip():
+                    raise SystemExit(
+                        f"{path}:{lineno}: malformed edge declaration "
+                        "(want edge::<lock> -> <lock>  # justification)")
+                declared.append((src.strip(), dst.strip()))
+                continue
+            if len(fields) != 3 or not all(fields):
+                raise SystemExit(
+                    f"{path}:{lineno}: malformed allowlist entry "
+                    f"(want path::rule::context  # justification, or "
+                    "edge::<lock> -> <lock>  # justification)")
+            out[tuple(fields)] = just
+    return out, declared
+
+
+def default_allowlist_path() -> str:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(repo_root, "tools", "lint_concurrency_allowlist.txt")
+
+
+def build_graph_json(paths: Optional[List[str]] = None,
+                     repo_root: Optional[str] = None,
+                     allowlist: Optional[str] = None) -> Dict:
+    """The shared static/dynamic edge vocabulary: lock name ->
+    construction site, plus every derived and declared edge. This is
+    what runtime/lockdep.py loads to match observed edges back to
+    their static prediction."""
+    if repo_root is None:
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))
+    if paths is None:
+        paths = [os.path.join(repo_root, "spark_rapids_tpu")]
+    _, declared = load_allowlist(allowlist or default_allowlist_path())
+    model = build_model(paths, repo_root)
+    edges = sorted(set(model.edges) | {e for e in declared
+                                       if e[0] != e[1]})
+    return {
+        "locks": {name: decl.site
+                  for name, decl in sorted(model.locks.items())},
+        "edges": [list(e) for e in edges],
+        "declared": [list(e) for e in sorted(set(declared))],
+    }
+
+
+def main(argv=None) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ap = argparse.ArgumentParser(
+        description="Concurrency linter: lock-order graph, "
+                    "blocking-under-lock, worker isolation "
+                    "(docs/analysis.md#concurrency-invariants)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: spark_rapids_tpu)")
+    ap.add_argument("--allowlist", default=default_allowlist_path())
+    ap.add_argument("--list", action="store_true",
+                    help="print every finding, including allowlisted")
+    ap.add_argument("--emit-graph", metavar="FILE",
+                    help="write the lock graph JSON (lock name -> "
+                         "construction site, edges) to FILE ('-' for "
+                         "stdout) and exit")
+    args = ap.parse_args(argv)
+    paths = args.paths or [os.path.join(repo_root, "spark_rapids_tpu")]
+    allow, declared = load_allowlist(args.allowlist)
+    if args.emit_graph:
+        graph = build_graph_json(paths, repo_root, args.allowlist)
+        text = json.dumps(graph, indent=2, sort_keys=True)
+        if args.emit_graph == "-":
+            print(text)
+        else:
+            with open(args.emit_graph, "w") as f:
+                f.write(text + "\n")
+        return 0
+    model = build_model(paths, repo_root)
+    _find_cycles(model, declared)
+    findings = sorted(model.findings,
+                      key=lambda f: (f.path, f.line, f.rule, f.context))
+    used: Set[Tuple[str, str, str]] = set()
+    open_findings: List[Finding] = []
+    emitted: Set[tuple] = set()
+    for f in findings:
+        dedup = (f.key(), f.message)
+        if dedup in emitted:
+            continue
+        emitted.add(dedup)
+        if f.key() in allow:
+            used.add(f.key())
+            if args.list:
+                print(f"ALLOWED {f}  # {allow[f.key()]}")
+        else:
+            open_findings.append(f)
+    for f in open_findings:
+        print(f)
+    stale = set(allow) - used
+    for key in sorted(stale):
+        print(f"STALE allowlist entry (matches no finding — prune it): "
+              f"{'::'.join(key)}")
+    if open_findings or stale:
+        print(f"lint_concurrency: {len(open_findings)} finding(s), "
+              f"{len(stale)} stale allowlist entr(ies) "
+              f"({len(used)} allowlisted; "
+              f"{len(model.edges)} lock-order edge(s))")
+        return 1
+    print(f"lint_concurrency: clean ({len(used)} vetted exception(s), "
+          f"{len(model.locks)} lock class(es), "
+          f"{len(model.edges)} lock-order edge(s), "
+          f"{len(declared)} declared edge(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -25,7 +25,10 @@ this makes the catch permanent and premerge-enforced (ci/premerge.sh):
   functions — nondeterministic order feeding a structural hash silently
   splits the compiled-program cache (or worse, collides).
 - ``lock-discipline``: inconsistent lock guards in a lock-owning class
-  (one that assigns ``threading.Lock()``/``RLock()`` to an attribute).
+  (one that assigns ``threading.Lock()``/``RLock()``/``Condition()``
+  to an attribute — a ``Condition(self._lock)`` is the same sync
+  object as the lock it wraps, so ``with self._cv:`` regions count as
+  locked whatever the condition is named).
   Any attribute the class mutates under its lock somewhere is SHARED
   STATE; mutating it anywhere else without the lock is a race waiting
   for a second thread (the PR 11 thread-safety classes — `StatsStore`,
@@ -515,9 +518,16 @@ _LOCK_EXEMPT_METHODS = {"__init__", "__post_init__", "__enter__",
 
 
 def _is_lock_ctor(node) -> bool:
-    """threading.Lock()/RLock() (any dotted prefix)."""
+    """threading.Lock()/RLock()/Condition() (any dotted prefix).
+    Condition counts structurally: `self._cv = threading.Condition(
+    self._lock)` names the SAME sync object as the lock it wraps, so
+    `with self._cv:` regions are locked evidence for lock-discipline —
+    previously only conditions whose NAME matched the _lockish
+    heuristic (scheduler.py's `_lock_cond`) were recognized, and a
+    condition named `_cv` read as two unrelated sync objects."""
     return (isinstance(node, ast.Call)
-            and _dotted(node.func).split(".")[-1] in ("Lock", "RLock"))
+            and _dotted(node.func).split(".")[-1] in ("Lock", "RLock",
+                                                      "Condition"))
 
 
 def _self_attr_of(node) -> str:
