@@ -45,6 +45,21 @@ COMPUTED it (the consistent-hash locality + promotion proof). Each
 session's JSONL row carries the `worker_id` stamp alongside the serving
 stamps (lint_metrics-enforced for fleet-path rows).
 
+Self-healing phase (appended to the fleet soak, docs/serving.md#fleet-
+self-healing): a SECOND fleet comes up with auto-respawn, hot
+replication, the health sweep, and quarantine=degrade armed, under
+`SPARK_RAPIDS_TPU_BREAKER_COOLDOWN_S=0` so breaker trips stick OPEN.
+One worker is KILLED mid-storm and a poison plan (its device-tier
+executions trip the worker's breaker) gets two more workers REAPED by
+the sweep — and the phase asserts the full healing loop: the fleet
+returns to N workers (respawns), the poison fingerprint is quarantined
+after its second distinct-worker trip and never trips a third, the
+killed worker's hot fingerprint survives as a REPLICA cache hit on its
+ring successor, a once-run fingerprint re-executes on the rehomed
+worker with gossiped observed stats (`charge_source == "observed"`,
+`attempts == 1`), a graceful drain returns to N again, and ZERO
+sessions fail through all of it.
+
 Lockdep-armed soak (SPARK_RAPIDS_TPU_LOCKDEP=1, any mode): every
 engine lock is constructed through the runtime lock-order witness
 (runtime/lockdep.py), rows stamp `lockdep_edges`/`lockdep_cycles`, and
@@ -258,6 +273,247 @@ def soak_serving(args):
           "breaker recovered")
 
 
+def _wrap_poison(fleet, poison_fp, tripped):
+    """Arm the poison plan on every (not-yet-wrapped) worker: a
+    device-tier execution of `poison_fp` trips that worker's breaker —
+    attributed, because the dispatcher's attribution scope is already
+    installed — and completes on the CPU tier so the TICKET still
+    resolves (the worker dies, the tenant must not). Deterministic
+    per-worker failure modeling: faultinj poisons the process-global
+    device, which thread-mode fleet workers share, so it cannot model
+    'this plan kills whichever worker runs it'."""
+    for w in fleet._workers.values():
+        if not w.alive or getattr(w.executor, "_soak_poisoned", False):
+            continue
+        w.executor._soak_poisoned = True
+
+        def _mk(orig, w=w):
+            def execute(plan, inputs=None, **kw):
+                if plan.fingerprint == poison_fp \
+                        and kw.get("tier") != "cpu":
+                    w.health.trip("fatal",
+                                  RuntimeError("soak poison plan"))
+                    tripped.append(w.id)
+                    kw = dict(kw, tier="cpu")
+                return orig(plan, inputs, **kw)
+            return execute
+        w.executor.execute = _mk(w.executor.execute)
+
+
+def _soak_selfheal(args, solo):
+    """Self-healing phase (module docstring): kill + poison-reap storm
+    against a respawn-enabled fleet; returns the emit_record fields."""
+    from spark_rapids_tpu.serving import FleetScheduler
+    from benchmarks.nds_plans import kernels_of
+    import numpy as _np
+    import jax.numpy as _jnp
+    from spark_rapids_tpu import Column, Table, dtypes
+    from spark_rapids_tpu.plan import PlanBuilder, col
+
+    n_workers = max(3, args.workers)
+
+    def _plan(thr):
+        b = PlanBuilder()
+        return (b.scan("t", schema=["k", "v"])
+                .filter(col("v") > thr)
+                .aggregate(["k"], [("v", "sum", "total")])
+                .sort(["k"]).build())
+
+    def _tab(seed, rows=10_000):
+        rng = _np.random.default_rng(seed)
+        return Table(
+            [Column(dtype=dtypes.INT64, length=rows,
+                    data=_jnp.asarray(rng.integers(
+                        0, hi, rows, dtype=_np.int64)))
+             for hi in (50, 200)], names=["k", "v"])
+
+    warm_tab = _tab(11)
+    prev_cd = os.environ.get("SPARK_RAPIDS_TPU_BREAKER_COOLDOWN_S")
+    # cooldown 0: a tripped breaker stays OPEN (no self-arming
+    # half-open), which is exactly the stuck state reap_unhealthy and
+    # the sweep exist for — trips become reaps become respawns
+    os.environ["SPARK_RAPIDS_TPU_BREAKER_COOLDOWN_S"] = "0"
+    try:
+        with FleetScheduler(workers=n_workers, respawn=True,
+                            respawn_max=16, respawn_backoff_ms=1,
+                            quarantine="degrade", hot_replicas=1,
+                            hot_k=8, sweep_ms=25) as fleet:
+            # two plans sharing a ring home (scan thresholds until two
+            # collide): ONE kill then proves both warm stories — the
+            # twice-run plan survives as a replica hit, the once-run
+            # plan re-executes warm off gossiped stats
+            hot_plan = _plan(0)
+            home0 = fleet._ring.route(hot_plan.fingerprint)
+            once_plan = next(
+                p for p in (_plan(t) for t in range(1, 200))
+                if fleet._ring.route(p.fingerprint) == home0)
+            poison_plan = next(
+                p for p in (_plan(t) for t in range(200, 400))
+                if p.fingerprint not in (hot_plan.fingerprint,
+                                         once_plan.fingerprint))
+            refs = {p.fingerprint: solo.execute(
+                p, {"t": warm_tab}).table.to_pydict()
+                for p in (hot_plan, once_plan, poison_plan)}
+
+            def _check(res, plan):
+                if res.table.to_pydict() != refs[plan.fingerprint]:
+                    raise SystemExit("self-heal soak: parity MISS")
+                return res
+
+            sA = fleet.open_session("healer", quota_bytes=1 << 50)
+            # warm round: hot_plan runs TWICE (>= 2 runs + top-K ->
+            # replicated to its ring successor), once_plan runs once
+            # (observed stats on home0 only — until gossip)
+            _check(sA.run(hot_plan, {"t": warm_tab}), hot_plan)
+            _check(sA.run(hot_plan, {"t": warm_tab}), hot_plan)
+            _check(sA.run(once_plan, {"t": warm_tab}), once_plan)
+            if fleet.metrics()["replications"] < 1:
+                raise SystemExit("self-heal soak: hot fingerprint was "
+                                 "not replicated after its second run")
+            # light storm riding through the healing events
+            sB = fleet.open_session("storm-b", quota_bytes=1 << 50)
+            sC = fleet.open_session("storm-c", quota_bytes=1 << 50)
+            storm = []
+            for t in range(100, 106):
+                p = _plan(t)
+                refs[p.fingerprint] = solo.execute(
+                    p, {"t": warm_tab}).table.to_pydict()
+                storm.append((p, sB.submit(p, {"t": warm_tab})))
+                storm.append((p, sC.submit(p, {"t": warm_tab})))
+
+            def _await_heal(deadline_s=30.0, dead=()):
+                t_end = time.monotonic() + deadline_s
+                while time.monotonic() < t_end:
+                    with fleet._lock:
+                        routable = [w.id for w
+                                    in fleet._routable_locked()]
+                    if len(routable) >= n_workers and \
+                            not (set(dead) & set(routable)):
+                        return routable
+                    time.sleep(0.02)
+                raise SystemExit(
+                    f"self-heal soak: fleet did not heal back to "
+                    f"{n_workers} workers (routable={routable}, "
+                    f"dead={list(dead)})")
+
+            # KILL mid-storm: home0 dies holding the warm state
+            fleet.kill_worker(home0)
+            _await_heal(dead=[home0])
+            # warm proof 1 — replica hit: the ring rehomes hot_plan to
+            # exactly the successor the replica was pushed to
+            tk = sA.submit(hot_plan, {"t": warm_tab})
+            res = _check(tk.result(timeout=600), hot_plan)
+            if not tk.cached or tk.worker == home0:
+                raise SystemExit(
+                    "self-heal soak: hot fingerprint did not survive "
+                    f"its home's death as a replica hit (cached="
+                    f"{tk.cached}, worker={tk.worker})")
+            # warm proof 2 — gossip: once_plan re-executes on the
+            # rehomed worker, but the kill gossiped home0's observed
+            # stats to every survivor: admission charges observed
+            # bytes (not certified bounds) and compilation is ONE shot
+            tk = sA.submit(once_plan, {"t": warm_tab})
+            res = _check(tk.result(timeout=600), once_plan)
+            if tk.charge_source != "observed" or res.attempts != 1:
+                raise SystemExit(
+                    "self-heal soak: rehomed fingerprint was not warm "
+                    f"(charge_source={tk.charge_source}, "
+                    f"attempts={res.attempts})")
+            # POISON storm: device-tier executions of poison_plan trip
+            # whichever worker runs them; cooldown 0 pins the breaker
+            # OPEN, the sweep reaps, respawn replaces. Fresh inputs per
+            # submission (new digest) so no cache hit short-circuits
+            # the trip. After TWO distinct worker incarnations trip,
+            # the fingerprint is quarantined — the third submission is
+            # CPU-pinned (degrade policy) and trips NOBODY.
+            tripped = []
+            _wrap_poison(fleet, poison_plan.fingerprint, tripped)
+            for round_i, seed in enumerate((21, 22)):
+                ptab = _tab(seed)
+                pref = solo.execute(
+                    poison_plan, {"t": ptab}).table.to_pydict()
+                ptk = sA.submit(poison_plan, {"t": ptab})
+                if ptk.result(timeout=600).table.to_pydict() != pref:
+                    raise SystemExit("self-heal soak: poison parity "
+                                     f"MISS (round {round_i})")
+                _await_heal(dead=tripped)
+                _wrap_poison(fleet, poison_plan.fingerprint, tripped)
+            if len(set(tripped)) != 2:
+                raise SystemExit(
+                    f"self-heal soak: expected trips on exactly 2 "
+                    f"distinct workers, got {tripped}")
+            if poison_plan.fingerprint not in fleet.quarantined():
+                raise SystemExit("self-heal soak: poison fingerprint "
+                                 "not quarantined after 2 distinct "
+                                 "worker trips")
+            ptab = _tab(23)
+            pref = solo.execute(
+                poison_plan, {"t": ptab}).table.to_pydict()
+            ptk = sA.submit(poison_plan, {"t": ptab})
+            if ptk.result(timeout=600).table.to_pydict() != pref:
+                raise SystemExit("self-heal soak: quarantined plan "
+                                 "lost parity on the CPU pin")
+            if len(tripped) != 2:
+                raise SystemExit(
+                    "self-heal soak: a QUARANTINED fingerprint tripped "
+                    f"a third breaker ({tripped}) — quarantine is not "
+                    "containing the crash amplifier")
+            # graceful drain: in-flight work finishes, fleet heals back
+            with fleet._lock:
+                drainee = fleet._routable_locked()[0].id
+            fleet.drain_worker(drainee, timeout=120)
+            routable = _await_heal(dead=[drainee])
+            # the storm rode through kill/reap/drain: every ticket
+            # resolves with parity, no session fails
+            for p, tk in storm:
+                if tk.result(
+                        timeout=600).table.to_pydict() != \
+                        refs[p.fingerprint]:
+                    raise SystemExit("self-heal soak: storm parity "
+                                     "MISS across healing events")
+            fm = fleet.metrics()
+            failed = sum(
+                s["failed"]
+                for wd in fm["workers"].values() if wd["serving"]
+                for s in wd["serving"]["sessions"].values())
+            if failed:
+                raise SystemExit(f"self-heal soak: {failed} session "
+                                 "failures — healing dropped work")
+            if fm["killed"] < 1 or fm["reaped"] < 2 or \
+                    fm["drained"] < 1 or fm["respawned"] < 4:
+                raise SystemExit(
+                    "self-heal soak: healing counters did not move "
+                    f"(killed={fm['killed']}, reaped={fm['reaped']}, "
+                    f"drained={fm['drained']}, "
+                    f"respawned={fm['respawned']})")
+            ld_edges, ld_cycles = _lockdep_stats()
+            emit_record(
+                "chaos_soak_fleet_selfheal",
+                {"workers": n_workers, "rows": 10_000},
+                res.wall_ms or 1e-3, 10_000,
+                impl="serving_fleet", session="healer",
+                worker_id=tk.worker or routable[0],
+                respawns=fm["respawned"],
+                replays=fm["replayed_jobs"],
+                cache_hit=True, kernels=kernels_of(res),
+                degraded=False, retries=0,
+                quarantined=len(fm["quarantined"]),
+                reaped=fm["reaped"], drained=fm["drained"],
+                lockdep_edges=ld_edges, lockdep_cycles=ld_cycles)
+            print(f"self-heal soak OK: killed 1 + reaped "
+                  f"{fm['reaped']} + drained {fm['drained']}, "
+                  f"{fm['respawned']} respawned (fleet back to "
+                  f"{len(routable)}), poison quarantined after "
+                  f"{len(set(tripped))} distinct-worker trips, "
+                  f"replica hit + observed-charge rehome proven, "
+                  f"0 failed sessions")
+    finally:
+        if prev_cd is None:
+            os.environ.pop("SPARK_RAPIDS_TPU_BREAKER_COOLDOWN_S", None)
+        else:
+            os.environ["SPARK_RAPIDS_TPU_BREAKER_COOLDOWN_S"] = prev_cd
+
+
 def soak_fleet(args):
     """`--workers N` mode: the chaos storm through the fleet tier with a
     deliberate mid-storm worker kill (module docstring)."""
@@ -426,6 +682,10 @@ def soak_fleet(args):
                     replays=sum(t.replays for t, _ in per_session[sid]))
     finally:
         faultinj.uninstall()
+    # phase 2: the self-healing storm (kill + poison-reap + drain
+    # against a respawn-enabled fleet) — separate fleet, same process,
+    # so the lockdep witness certifies BOTH phases' lock traffic
+    _soak_selfheal(args, solo)
     _lockdep_certify()
     print(f"fleet soak OK: {n_sessions} sessions x {plans_per_session} "
           f"plans over {n_workers} workers, killed {victim} mid-storm "

@@ -39,14 +39,25 @@ JAX_PLATFORMS=cpu python benchmarks/chaos_soak.py --scale 0.2 --cpu --sessions 8
 # bit-exact per-session parity for every completion, a bounded p99 queue
 # wait, and >=1 parity-checked cache hit SERVED by a different worker
 # than the one that COMPUTED it (consistent-hash locality + promotion);
-# per-session JSONL rows carry the worker_id stamp (lint_metrics-enforced)
+# per-session JSONL rows carry the worker_id stamp (lint_metrics-enforced).
+# The run then adds a SELF-HEALING phase (docs/serving.md#fleet-self-
+# healing) on a respawning fleet: a kill, two poison-plan breaker trips
+# on distinct workers, and a graceful drain, all mid-storm — asserts the
+# fleet heals back to its target size with zero failed sessions, the
+# poison fingerprint quarantined after the second distinct-worker trip
+# (never a third), a post-kill replica cache hit from a different
+# worker, and a gossip-warmed rehome (observed-bytes charge, one
+# compile); the self-heal JSONL row stamps respawns + worker_id
+# (lint_metrics missing-respawn-stamp rule)
 JAX_PLATFORMS=cpu python benchmarks/chaos_soak.py --scale 0.2 --cpu --sessions 8 --workers 3
 # lockdep-armed fleet soak (runtime/lockdep.py, docs/analysis.md#
-# concurrency-invariants): the same storm with every engine lock traced
-# by the runtime lock-order witness — FAILS on any observed lock-order
-# cycle or any dynamic edge missing from the static linter's graph
-# (tools/lint_concurrency.py), and rows stamp lockdep_edges/
-# lockdep_cycles so the JSONL history shows witness coverage
+# concurrency-invariants): the same storm — self-healing phase included,
+# so the respawn/drain/gossip paths are witnessed too — with every
+# engine lock traced by the runtime lock-order witness; FAILS on any
+# observed lock-order cycle or any dynamic edge missing from the static
+# linter's graph (tools/lint_concurrency.py), and rows stamp
+# lockdep_edges/lockdep_cycles so the JSONL history shows witness
+# coverage
 JAX_PLATFORMS=cpu SPARK_RAPIDS_TPU_LOCKDEP=1 \
     python benchmarks/chaos_soak.py --scale 0.2 --cpu --sessions 8 --workers 3
 # optimizer parity (docs/optimizer.md): the four NDS plans, capped tier,

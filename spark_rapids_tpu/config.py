@@ -53,6 +53,13 @@ equivalents, all read at use time (not import time) so tests can monkeypatch:
 | SPARK_RAPIDS_TPU_FLEET_WORKERS | 1 | fleet serving tier (serving/fleet.py, docs/serving.md#fleet): executor workers behind the router; 1 (default) keeps the single-worker ServingScheduler path byte-identical |
 | SPARK_RAPIDS_TPU_FLEET_RING_REPLICAS | 64 | consistent-hash ring virtual nodes per worker — higher spreads fingerprints more evenly at slightly more route cost |
 | SPARK_RAPIDS_TPU_FLEET_SPILL_RATIO | 2.0 | load-aware spillover threshold: the routed worker sheds to the least-pressured replica when its pressure score exceeds ratio x (best score + 1); <=0 disables spillover |
+| SPARK_RAPIDS_TPU_FLEET_RESPAWN | off | fleet self-healing (serving/fleet.py): when on, a killed/reaped/drained worker is replaced by a fresh one (new id, fresh isolated stack, warm-up gossip) until the fleet is back at its configured size; "off" keeps the legacy shrink-only failover |
+| SPARK_RAPIDS_TPU_FLEET_RESPAWN_MAX | 16 | respawn budget: total replacement workers one fleet may spawn over its lifetime — a flapping environment must run out of budget, not respawn-storm |
+| SPARK_RAPIDS_TPU_FLEET_RESPAWN_BACKOFF_MS | 100 | minimum delay between consecutive respawns, doubling per respawn in a flap streak (a quiet period of 16x the base resets the streak) |
+| SPARK_RAPIDS_TPU_FLEET_QUARANTINE | reject | poison-fingerprint policy: a fingerprint whose executions tripped breakers on >=2 distinct workers is quarantined fleet-wide — "reject" fast-fails new submissions of it (typed ServingRejectedError), "degrade" pins them to the CPU tier |
+| SPARK_RAPIDS_TPU_FLEET_HOT_REPLICAS | 1 | warm failover: frozen cache entries of HOT fingerprints replicate to this many secondary ring owners (0 disables replication) |
+| SPARK_RAPIDS_TPU_FLEET_HOT_K | 8 | how many fingerprints (top-K by submissions seen at the router) count as HOT for replication (0 disables) |
+| SPARK_RAPIDS_TPU_FLEET_SWEEP_MS | 0 | background health-sweep period: a fleet thread reaps stuck-open breakers and tops the fleet back up to size every this-many ms; 0 (default) disables the thread — kill/reap call sites still respawn inline |
 | SPARK_RAPIDS_TPU_LOCKDEP         | 0    | runtime lock-order witness (runtime/lockdep.py, docs/analysis.md#concurrency-invariants): wrap engine locks, record held-set→acquired edges, raise on the first observed ordering cycle; armed by tests/conftest and the fleet chaos soak |
 
 The SPARK_RAPIDS_TPU_BREAKER_* numeric knobs are snapshotted when a
@@ -493,6 +500,86 @@ def fleet_spill_ratio() -> float:
     ratio x (best score + 1). Higher values prefer cache locality over
     load balance; <=0 disables spillover entirely."""
     return _float_env("SPARK_RAPIDS_TPU_FLEET_SPILL_RATIO", 2.0)
+
+
+def fleet_respawn() -> bool:
+    """Fleet self-healing gate (serving/fleet.py, docs/serving.md#fleet):
+    when on, kill_worker/reap_unhealthy/drain_worker (and the background
+    sweep, when armed) spawn a fresh replacement worker — new id, fresh
+    isolated executor/health/stats/cache stack, warm-up gossip from the
+    survivors — until the fleet is back at its configured size. Off
+    (default) keeps the legacy shrink-only failover, which several
+    regression tests pin. Same strict-typo policy as the kernel
+    selectors — a typo must not silently change failure-domain
+    behavior."""
+    v = os.environ.get("SPARK_RAPIDS_TPU_FLEET_RESPAWN", "off")
+    if v not in ("on", "off"):
+        raise ValueError(
+            f"SPARK_RAPIDS_TPU_FLEET_RESPAWN={v!r}: expected on or off")
+    return v == "on"
+
+
+def fleet_respawn_max() -> int:
+    """Respawn budget: the total number of replacement workers one fleet
+    may spawn over its lifetime. The bound is the respawn-storm guard —
+    an environment that keeps killing replacements (a genuinely dead
+    device, a poison plan the quarantine has not yet attributed) runs
+    out of budget and degrades to shrink-only failover instead of
+    spawning forever."""
+    return max(0, _int_env("SPARK_RAPIDS_TPU_FLEET_RESPAWN_MAX", 16))
+
+
+def fleet_respawn_backoff_ms() -> float:
+    """Minimum delay between consecutive respawns, doubled per respawn
+    while the fleet is flapping (a quiet period of 16x the base resets
+    the streak). A respawn arriving inside the backoff window is
+    deferred — the next kill/reap/sweep tick retries it."""
+    return max(0.0, _float_env(
+        "SPARK_RAPIDS_TPU_FLEET_RESPAWN_BACKOFF_MS", 100.0))
+
+
+def fleet_quarantine() -> str:
+    """Poison-fingerprint policy (serving/fleet.py): a fingerprint whose
+    executions tripped breakers on >= 2 DISTINCT workers is quarantined
+    fleet-wide — without this, auto-respawn is a crash amplifier (one
+    bad plan kills every replacement in a loop). "reject" fast-fails new
+    submissions of a quarantined fingerprint with a typed
+    ServingRejectedError("quarantined"); "degrade" pins them to the CPU
+    tier, where the device the plan keeps poisoning is not involved.
+    Same strict-typo policy as SPARK_RAPIDS_TPU_SERVING_OVER_QUOTA."""
+    v = os.environ.get("SPARK_RAPIDS_TPU_FLEET_QUARANTINE", "reject")
+    if v not in ("reject", "degrade"):
+        raise ValueError(
+            f"SPARK_RAPIDS_TPU_FLEET_QUARANTINE={v!r}: expected reject "
+            "or degrade")
+    return v
+
+
+def fleet_hot_replicas() -> int:
+    """Warm failover (serving/fleet.py): HOT fingerprints' frozen cache
+    entries replicate to this many secondary ring owners beyond the
+    primary, so losing the home worker loses neither the cached result
+    nor (with the stats gossip) the observed sizing. 0 disables
+    replication — promotion alone still shares entries reactively."""
+    return max(0, _int_env("SPARK_RAPIDS_TPU_FLEET_HOT_REPLICAS", 1))
+
+
+def fleet_hot_k() -> int:
+    """How many fingerprints count as HOT for replication: the top-K by
+    submissions observed at the router. Small by design — replication
+    multiplies resident cache bytes by (1 + replicas) for exactly the
+    traffic where a cold rehome would hurt most. 0 disables."""
+    return max(0, _int_env("SPARK_RAPIDS_TPU_FLEET_HOT_K", 8))
+
+
+def fleet_sweep_ms() -> float:
+    """Background health-sweep period (serving/fleet.py): when > 0 the
+    fleet runs a daemon thread that, every this-many ms, reaps workers
+    whose breaker is stuck OPEN with no cooldown and tops the fleet back
+    up to its configured size (respawn knob permitting) — so a worker
+    that dies while no kill/reap call site is active still gets
+    replaced. 0 (default) disables the thread."""
+    return max(0.0, _float_env("SPARK_RAPIDS_TPU_FLEET_SWEEP_MS", 0.0))
 
 
 def faultinj_config_path() -> str:

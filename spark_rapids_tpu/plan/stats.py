@@ -342,6 +342,85 @@ class StatsStore:
                 self.generation += 1
             return len(doomed)
 
+    # ---- fleet gossip (serving/fleet.py, docs/serving.md#fleet) ------------
+
+    def export_plans(self, fps=None) -> list:
+        """Snapshot the plan-level observations as gossip rows —
+        `{backend, source_fp, executed_fp, runs, caps, peak_bytes}` per
+        (backend, fingerprint) entry, restricted to `fps` when given.
+        This is the warm-failover payload: caps and high-water bytes are
+        what a rehomed fingerprint needs to compile once and charge
+        observed bytes immediately; per-op rows stay home (toposort-
+        indexed detail no remote consumer reads). Rows are copies — the
+        receiver's merge must not alias this store's tables."""
+        with self._lock:
+            out = []
+            for (backend, source_fp), ps in self._plans.items():
+                if fps is not None and source_fp not in fps:
+                    continue
+                out.append({"backend": backend, "source_fp": source_fp,
+                            "executed_fp": ps.get("executed_fp", ""),
+                            "runs": int(ps.get("runs", 0)),
+                            "caps": dict(ps.get("caps", {})),
+                            "peak_bytes": int(ps.get("peak_bytes", 0))})
+            return out
+
+    def merge_plans(self, rows) -> int:
+        """Merge gossip rows from a peer store: high-water everything
+        (caps, peak_bytes, runs), so the merge is idempotent and
+        order-independent — gossiping the same snapshot twice changes
+        nothing, which lets the fleet re-gossip without bookkeeping.
+        Returns the number of rows that changed anything; bumps
+        `generation` once if any did (cached rewrites must not outlive
+        observations they ignored, same rule as record_result)."""
+        changed = 0
+        with self._lock:
+            for row in rows:
+                try:
+                    key = (row["backend"], row["source_fp"])
+                    ps = self._plans.get(key)
+                    if ps is None:
+                        ps = {"executed_fp": row.get("executed_fp", ""),
+                              "runs": 0, "caps": {}, "peak_bytes": 0,
+                              "ops": {}}
+                    before = (ps["runs"], ps["peak_bytes"],
+                              dict(ps["caps"]))
+                    ps["runs"] = max(int(ps["runs"]),
+                                     int(row.get("runs", 0)))
+                    ps["peak_bytes"] = max(int(ps["peak_bytes"]),
+                                           int(row.get("peak_bytes", 0)))
+                    for k, v in (row.get("caps") or {}).items():
+                        ps["caps"][k] = max(int(ps["caps"].get(k, 0)),
+                                            int(v))
+                    if not ps.get("executed_fp"):
+                        ps["executed_fp"] = row.get("executed_fp", "")
+                    if (ps["runs"], ps["peak_bytes"], ps["caps"]) \
+                            != before:
+                        changed += 1
+                    self._plans[key] = ps
+                except (KeyError, TypeError, ValueError):
+                    continue    # tolerate a torn/foreign row, like _load
+            if changed:
+                self.generation += 1
+        return changed
+
+    def hot_fingerprints(self, k: int) -> list:
+        """The top-`k` source fingerprints by total observed runs across
+        backends — the store-side HOT signal replication can fall back
+        on when the router's own submission counter is cold (a respawned
+        worker inherits gossiped runs, not router history)."""
+        if k <= 0:
+            return []
+        with self._lock:
+            runs: Dict[str, int] = {}
+            for (_backend, source_fp), ps in sorted(self._plans.items()):
+                runs[source_fp] = runs.get(source_fp, 0) + \
+                    int(ps.get("runs", 0))
+        # ties break on the fingerprint, not dict insertion order — the
+        # hot set must be identical across stores holding the same rows
+        return [fp for fp, _ in sorted(runs.items(),
+                                       key=lambda kv: (-kv[1], kv[0]))[:k]]
+
     def op_stats(self, backend: str, source_fp: str) -> Dict[int, Dict]:
         """toposort index -> {rows_out, bytes_out, wall_ms, kernel} of
         the last recorded execution of this authored plan on `backend`.

@@ -230,6 +230,14 @@ class DeviceHealthMonitor:
         self._failures: Dict[tuple, Deque[float]] = {}
         self._reset_hooks: List[Callable[[], None]] = []
         self._metrics: Dict[str, float] = collections.defaultdict(float)
+        # trip attribution (serving/fleet.py poison quarantine): the
+        # fingerprint of the plan executing on THIS thread when a trip
+        # lands — thread-local, because the serving dispatcher runs
+        # several tenants' plans concurrently through one monitor.
+        # Bounded log, drained by the fleet with get-and-reset semantics
+        # like the metrics counters.
+        self._attr = threading.local()
+        self._trip_log: Deque[tuple] = collections.deque(maxlen=64)
 
     # ---- classification ----------------------------------------------------
 
@@ -316,6 +324,37 @@ class DeviceHealthMonitor:
 
     # ---- breaker lifecycle -------------------------------------------------
 
+    def attribution(self, fingerprint: str):
+        """Context manager installing `fingerprint` as the CURRENT
+        THREAD's trip attribution: a breaker trip landing inside the
+        scope logs (fingerprint, reason) for the fleet's poison-plan
+        quarantine (serving/fleet.py — a fingerprint that trips breakers
+        on >= 2 distinct workers is the crash amplifier auto-respawn
+        must not keep feeding). The serving dispatcher wraps every
+        execution in one; unattributed trips log fingerprint ""."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _scope():
+            prev = getattr(self._attr, "fp", "")
+            self._attr.fp = str(fingerprint)
+            try:
+                yield
+            finally:
+                self._attr.fp = prev
+        return _scope()
+
+    def drain_trips(self) -> List[tuple]:
+        """Drain the attributed-trip log — `[(fingerprint, reason),
+        ...]` since the last drain (get-and-reset, like the metrics
+        counters). The fleet absorbs these on every submit and before
+        every worker removal, so a dying worker's attributions are
+        collected before its stack is torn down."""
+        with self._lock:
+            out = list(self._trip_log)
+            self._trip_log.clear()
+        return out
+
     def trip(self, reason: str, exc: Optional[BaseException] = None) -> None:
         # the underlying error rides the snapshot: a degraded nightly run
         # must say WHICH failure tripped it, not just the classification
@@ -324,6 +363,8 @@ class DeviceHealthMonitor:
         with self._lock:
             self._metrics["trips"] += 1
             self._metrics[f"{reason}_trips"] += 1
+            self._trip_log.append(
+                (getattr(self._attr, "fp", ""), reason))
 
     def probe(self) -> bool:
         ok = self.breaker.probe()

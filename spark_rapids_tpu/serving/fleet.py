@@ -57,6 +57,39 @@ live worker: one worker's digest-keyed cache is already coherent by
 itself, and single-worker behavior must stay byte-identical to the
 plain scheduler.
 
+**Self-healing** (docs/serving.md#fleet-self-healing). Failover alone
+shrinks the fleet: every kill/reap permanently loses a worker's
+capacity. With `SPARK_RAPIDS_TPU_FLEET_RESPAWN=on` the fleet heals
+itself back to its target size:
+
+- **auto-respawn** — after a kill, reap, or drain the fleet spawns a
+  replacement worker with a fresh isolated stack and a NEW monotonic id
+  (ids are never reused: quarantine counts trips per worker
+  *incarnation*, and a name-recycling respawn would alias the dead
+  worker's history onto the newborn), gated by a lifetime budget
+  (`_RESPAWN_MAX`) and an exponential backoff (`_RESPAWN_BACKOFF_MS`)
+  so a crash-looping root cause cannot churn workers forever;
+- **poison-plan quarantine** — breaker trips are attributed to the
+  fingerprint that fired them (`DeviceHealthMonitor.attribution`); a
+  fingerprint that tripped breakers on >= 2 DISTINCT workers is
+  quarantined fleet-wide — rejected with a typed error or pinned to the
+  CPU tier per `_FLEET_QUARANTINE`. This check runs BEFORE respawn
+  logic on purpose: respawning workers under a poison plan without
+  quarantining it is a crash amplifier (each newborn dies the same way);
+- **graceful drain** — `drain_worker()` stops new routing immediately,
+  lets in-flight work finish under a deadline, then removes the worker
+  and replays only the stragglers (`failover_reason == "drained"`);
+- **warm failover** — HOT fingerprints (>= 2 observed runs AND top-K by
+  run count) replicate their frozen cache entries to the next
+  `_FLEET_HOT_REPLICAS` distinct ring successors, and the stats stores
+  gossip observed caps / high-water bytes to every survivor on worker
+  death and to every newborn on respawn — so a failover rehome serves
+  the replica (or compiles ONCE, `attempts == 1`) and charges observed
+  bytes immediately instead of re-learning the plan from scratch.
+
+A background sweep (`_FLEET_SWEEP_MS > 0`) runs reap + respawn
+periodically so healing does not wait for the next submission.
+
 With `SPARK_RAPIDS_TPU_FLEET_WORKERS=1` (the default) the fleet is one
 worker and every routing rule degenerates to "that worker" — serving
 behavior is the single-worker `ServingScheduler` path, regression-held
@@ -103,6 +136,29 @@ class FleetWorker:
                                           stats_store=self.stats,
                                           **(scheduler_kwargs or {}))
         self.alive = True
+        # draining: still alive (finishing in-flight work) but no NEW
+        # routing — the half-state graceful drain needs that kill lacks
+        self.draining = False
+
+    # The gossip surface: every cross-worker stats reach goes through
+    # these wrappers so the isolation linter (tools/lint_concurrency.py)
+    # can sanction the worker's OWN surface instead of allowlisting raw
+    # `w.stats.*` reaches all over fleet.py.
+
+    def drain_trips(self):
+        """Get-and-reset the health monitor's attributed trip log —
+        (fingerprint, reason) pairs the quarantine logic consumes."""
+        return self.health.drain_trips()
+
+    def gossip_export(self, fps=None):
+        """This worker's observed plan rows (caps, high-water bytes,
+        run counts) for merging into peers on death/drain/respawn."""
+        return self.stats.export_plans(fps)
+
+    def gossip_merge(self, rows) -> int:
+        """High-water merge of peer observations into this worker's
+        stats store; idempotent, returns the number of rows changed."""
+        return self.stats.merge_plans(rows)
 
     def pressure_score(self) -> float:
         """Scalar load rank for the router: queued + active work, plus a
@@ -131,7 +187,12 @@ class FleetTicket:
         self.inputs = inputs
         self.worker = ""                # serving worker id
         self.replays = 0
+        # why this ticket ever left its first worker: "" (never did),
+        # "killed" / "reaped" / "drained" (proactive fleet failover) or
+        # "self_heal" (result() discovered the death itself)
+        self.failover_reason = ""
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
         self._inner: Optional[Ticket] = None
         self._inner_worker = ""
         self._failed: Optional[BaseException] = None
@@ -143,6 +204,17 @@ class FleetTicket:
             self._inner_worker = worker_id
             self.worker = worker_id
             inner.worker = worker_id
+            self._cond.notify_all()
+        # register OUTSIDE the ticket lock: an already-completed inner
+        # invokes the callback inline, and _wake re-takes the lock
+        inner.add_done_callback(self._wake)
+
+    def _wake(self, _inner) -> None:
+        """Done-callback from the CURRENT (or a superseded) inner
+        ticket: wake result() waiters. Spurious wakeups from a stale
+        inner are harmless — the waiter re-checks under the lock."""
+        with self._lock:
+            self._cond.notify_all()
 
     def _current(self):
         with self._lock:
@@ -155,6 +227,7 @@ class FleetTicket:
         `done()` that already answered False and will never re-poll."""
         with self._lock:
             self._failed = err
+            self._cond.notify_all()
 
     def done(self) -> bool:
         with self._lock:
@@ -181,7 +254,13 @@ class FleetTicket:
     def result(self, timeout: Optional[float] = None):
         """Block for the outcome, transparently surviving worker death:
         a typed `closed` rejection from a worker the fleet knows is dead
-        replays on a survivor instead of raising."""
+        replays on a survivor instead of raising.
+
+        Waits on a condition the inner ticket's done-callback notifies
+        (`_wake`, re-armed on every re-bind) — completion wakes the
+        waiter immediately instead of on the next slot of a fixed poll
+        loop. The bounded wait slice below is insurance against a
+        missed edge, not the wakeup mechanism."""
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
         while True:
@@ -189,20 +268,29 @@ class FleetTicket:
                 if self._failed is not None:
                     raise self._failed
                 inner, wid = self._inner, self._inner_worker
-            remaining = (None if deadline is None
-                         else deadline - time.monotonic())
-            if remaining is not None and remaining <= 0:
-                raise TimeoutError(
-                    f"fleet ticket [session={self.session}] not complete "
-                    f"after {timeout}s")
-            slice_s = 0.1 if remaining is None else min(0.1, remaining)
+                if inner is None or not inner.done():
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    if remaining is not None and remaining <= 0:
+                        raise TimeoutError(
+                            f"fleet ticket [session={self.session}] not "
+                            f"complete after {timeout}s")
+                    self._cond.wait(1.0 if remaining is None
+                                    else min(1.0, remaining))
+                    continue
+            # harvest OUTSIDE the ticket lock: result(0) cannot block
+            # (inner.done() above), and the self-heal path below takes
+            # fleet-level locks the ticket lock must never sit under
             try:
-                return inner.result(slice_s)
+                return inner.result(0)
             except TimeoutError:
-                continue
+                continue        # raced with a re-bind: re-check
             except ServingRejectedError as e:
                 if e.reason == "closed" and \
                         not self._fleet._worker_alive(wid):
+                    with self._lock:
+                        if not self.failover_reason:
+                            self.failover_reason = "self_heal"
                     self._fleet._replay(self)
                     continue
                 raise
@@ -273,12 +361,39 @@ class FleetScheduler:
     def __init__(self, workers: Optional[int] = None, *,
                  ring_replicas: Optional[int] = None,
                  spill_ratio: Optional[float] = None,
+                 respawn: Optional[bool] = None,
+                 respawn_max: Optional[int] = None,
+                 respawn_backoff_ms: Optional[float] = None,
+                 quarantine: Optional[str] = None,
+                 hot_replicas: Optional[int] = None,
+                 hot_k: Optional[int] = None,
+                 sweep_ms: Optional[float] = None,
                  scheduler_kwargs: Optional[Dict] = None):
         from .. import config
         n = (config.fleet_workers() if workers is None
              else max(1, int(workers)))
         self.spill_ratio = (config.fleet_spill_ratio() if spill_ratio
                             is None else float(spill_ratio))
+        # self-healing knobs (docs/serving.md#fleet-self-healing)
+        self.respawn = (config.fleet_respawn() if respawn is None
+                        else bool(respawn))
+        self.respawn_max = (config.fleet_respawn_max() if respawn_max
+                            is None else max(0, int(respawn_max)))
+        self.respawn_backoff_ms = (
+            config.fleet_respawn_backoff_ms() if respawn_backoff_ms
+            is None else max(0.0, float(respawn_backoff_ms)))
+        self.quarantine_policy = (config.fleet_quarantine()
+                                  if quarantine is None else quarantine)
+        if self.quarantine_policy not in ("reject", "degrade"):
+            raise ValueError(
+                f"quarantine policy must be 'reject' or 'degrade', "
+                f"got {self.quarantine_policy!r}")
+        self.hot_replicas = (config.fleet_hot_replicas() if hot_replicas
+                             is None else max(0, int(hot_replicas)))
+        self.hot_k = (config.fleet_hot_k() if hot_k is None
+                      else max(0, int(hot_k)))
+        self.sweep_ms = (config.fleet_sweep_ms() if sweep_ms is None
+                         else max(0.0, float(sweep_ms)))
         self._lock = threading.Lock()
         self._workers: Dict[str, FleetWorker] = {}
         self._ring = HashRing(replicas=ring_replicas)
@@ -287,6 +402,19 @@ class FleetScheduler:
         # invalidation bus state: last input digest seen per fingerprint
         from ..utils.lru import LruDict
         self._digests: Dict[str, str] = LruDict(4096)
+        # self-healing state: the size auto-respawn heals back to, the
+        # monotonic worker-id counter (ids are NEVER reused — quarantine
+        # counts trips per distinct worker incarnation), the poison map
+        # (fingerprint -> worker ids whose breakers it tripped), the
+        # quarantine set, the respawn rate-limit clock, and the router-
+        # side run counter hot replication ranks fingerprints by
+        self.target_workers = n
+        self._widx = n
+        self._poison: Dict[str, Set[str]] = LruDict(512)
+        self._quarantined: Dict[str, str] = LruDict(256)
+        self._respawn_last = 0.0
+        self._respawn_streak = 0
+        self._fp_runs: Dict[str, int] = LruDict(4096)
         # observability counters
         self.routes_affinity = 0
         self.routes_ring = 0
@@ -295,10 +423,27 @@ class FleetScheduler:
         self.replayed_jobs = 0
         self.bus_publishes = 0
         self.cache_promotions = 0
+        self.killed = 0
+        self.reaped = 0
+        self.drained = 0
+        self.respawned = 0
+        self.respawn_deferred = 0
+        self.replications = 0
+        self.gossips = 0
+        self.quarantine_hits = 0
         for i in range(n):
             self._add_worker_locked(f"w{i}",
                                     scheduler_kwargs=scheduler_kwargs)
         self._scheduler_kwargs = dict(scheduler_kwargs or {})
+        # background health sweep: reap stuck-OPEN breakers and top the
+        # fleet back up without waiting for the next submission to
+        # trigger healing (0 = off; tests drive healing synchronously)
+        self._sweep_stop = threading.Event()
+        self._sweep_thread: Optional[threading.Thread] = None
+        if self.sweep_ms > 0:
+            self._sweep_thread = threading.Thread(
+                target=self._sweep_loop, name="fleet-sweep", daemon=True)
+            self._sweep_thread.start()
 
     # ---- membership --------------------------------------------------------
 
@@ -308,18 +453,26 @@ class FleetScheduler:
         self._ring.add(wid)
         return w
 
+    def _next_wid_locked(self) -> str:
+        """Monotonic, never-reused worker id. Reusing a dead worker's
+        name would alias its incarnation in the poison map — a respawn
+        that 'inherits' the trips of the corpse it replaced would
+        quarantine fingerprints off one worker's evidence."""
+        wid = f"w{self._widx}"
+        self._widx += 1
+        return wid
+
     def add_worker(self) -> str:
         """Scale out by one worker (join): only ~1/n of the fingerprint
-        keyspace re-homes onto it."""
+        keyspace re-homes onto it. Raises the self-healing target size
+        — the fleet now heals back to the larger fleet."""
         with self._lock:
             if self._closed:
                 raise ServingRejectedError("closed", "fleet is shut down")
-            i = 0
-            while f"w{i}" in self._workers:
-                i += 1
-            wid = f"w{i}"
+            wid = self._next_wid_locked()
             self._add_worker_locked(
                 wid, scheduler_kwargs=self._scheduler_kwargs)
+            self.target_workers += 1
         return wid
 
     def _worker_alive(self, wid: str) -> bool:
@@ -330,7 +483,14 @@ class FleetScheduler:
     def _live_workers_locked(self) -> List[FleetWorker]:
         return [w for w in self._workers.values() if w.alive]
 
-    def kill_worker(self, wid: str) -> int:
+    def _routable_locked(self) -> List[FleetWorker]:
+        """Workers new submissions may land on: alive and not draining
+        (a draining worker still finishes its in-flight work — it is
+        live for gossip and the invalidation bus, dead for routing)."""
+        return [w for w in self._workers.values()
+                if w.alive and not w.draining]
+
+    def kill_worker(self, wid: str, *, _cause: str = "killed") -> int:
         """Deliberate worker death (the chaos soak's kill-mid-storm):
         remove from the ring, fail its queue, replay every incomplete
         tracked submission on a survivor. Returns the number of
@@ -340,17 +500,36 @@ class FleetScheduler:
         re-submissions). In-execution jobs whose tickets were already
         re-bound discard the late result (first-completion-wins is
         safe: execution is deterministic, both completions are the
-        same bytes)."""
+        same bytes).
+
+        Before the worker disappears the fleet (1) absorbs its
+        attributed breaker trips into the poison map — the incarnation
+        dies, its evidence does not — and (2) gossips its stats-store
+        observations to every survivor, so rehomed fingerprints charge
+        observed bytes (and skip compile churn) wherever they land.
+        With respawn enabled a replacement is spawned afterward."""
         with self._lock:
             w = self._workers.get(wid)
             if w is None or not w.alive:
                 return 0
-            if len(self._live_workers_locked()) <= 1:
+            routable = self._routable_locked()
+            if w in routable and len(routable) <= 1:
                 raise ValueError(
                     f"cannot kill {wid}: it is the last live worker")
+            self._absorb_trips_locked(w)
+            rows = w.gossip_export()
+            if rows:
+                for peer in self._live_workers_locked():
+                    if peer is not w:
+                        peer.gossip_merge(rows)
+                        self.gossips += 1
             w.alive = False
             self._ring.remove(wid)
             self.failovers += 1
+            if _cause == "reaped":
+                self.reaped += 1
+            else:
+                self.killed += 1
             orphans: List[FleetTicket] = []
             for rec in self._sessions.values():
                 if rec.affinity == wid:
@@ -360,6 +539,7 @@ class FleetScheduler:
                     if t.done():
                         rec.tickets.discard(t)
                     elif t._current()[1] == wid:
+                        t.failover_reason = t.failover_reason or _cause
                         orphans.append(t)
         # close OUTSIDE the fleet lock: drain=False completes queued
         # tickets with the typed "closed" rejection (self-heal path) and
@@ -368,6 +548,7 @@ class FleetScheduler:
         w.scheduler.close(drain=False, timeout=30.0)
         for t in orphans:
             self._replay(t)
+        self._maybe_respawn()
         return len(orphans)
 
     def reap_unhealthy(self) -> List[str]:
@@ -376,7 +557,9 @@ class FleetScheduler:
         until operator intervention, so its sessions fail over now. A
         breaker WITH a cooldown is left alone — it will half-open and
         probe by itself, and the CPU-degraded tier keeps serving
-        meanwhile. Never kills the last live worker."""
+        meanwhile. Never kills the last live worker. Reaps count under
+        `metrics()["reaped"]` (not `killed`), and with respawn enabled
+        each reap spawns a replacement."""
         doomed = []
         with self._lock:
             for w in self._live_workers_locked():
@@ -386,11 +569,207 @@ class FleetScheduler:
         out = []
         for wid in doomed:
             try:
-                self.kill_worker(wid)
+                self.kill_worker(wid, _cause="reaped")
                 out.append(wid)
             except ValueError:
                 break               # last live worker: keep serving
         return out
+
+    def drain_worker(self, wid: str,
+                     timeout: Optional[float] = None) -> int:
+        """Graceful decommission: stop routing NEW work at `wid`
+        immediately (ring removal + affinity unpin), let its in-flight
+        and queued work FINISH under `timeout`, then remove it and
+        replay only the stragglers the deadline cut off
+        (`failover_reason == "drained"`). The polite sibling of
+        `kill_worker` — a planned node rotation should not throw away
+        work the worker was mid-way through. Returns the number of
+        stragglers replayed; with respawn enabled a replacement is
+        spawned afterward."""
+        with self._lock:
+            w = self._workers.get(wid)
+            if w is None or not w.alive or w.draining:
+                return 0
+            routable = self._routable_locked()
+            if w in routable and len(routable) <= 1:
+                raise ValueError(
+                    f"cannot drain {wid}: it is the last live worker")
+            w.draining = True
+            self._ring.remove(wid)
+            self._absorb_trips_locked(w)
+            rows = w.gossip_export()
+            if rows:
+                for peer in self._routable_locked():
+                    peer.gossip_merge(rows)
+                    self.gossips += 1
+            for rec in self._sessions.values():
+                if rec.affinity == wid:
+                    rec.affinity = None
+        # drain OUTSIDE the fleet lock: this BLOCKS until the worker's
+        # queue and active jobs finish (or the deadline) — the whole
+        # point of drain over kill, and exactly why the lock can't be
+        # held (every route would stall behind the drain)
+        w.scheduler.close(drain=True, timeout=timeout)
+        stragglers: List[FleetTicket] = []
+        with self._lock:
+            w.alive = False
+            self.failovers += 1
+            self.drained += 1
+            for rec in self._sessions.values():
+                rec.handles.pop(wid, None)
+                for t in list(rec.tickets):
+                    if t.done():
+                        rec.tickets.discard(t)
+                    elif t._current()[1] == wid:
+                        t.failover_reason = t.failover_reason or "drained"
+                        stragglers.append(t)
+        for t in stragglers:
+            self._replay(t)
+        self._maybe_respawn()
+        return len(stragglers)
+
+    # ---- self-healing ------------------------------------------------------
+
+    def _absorb_trips_locked(self, w: FleetWorker) -> None:
+        """Drain `w`'s attributed breaker-trip log into the poison map
+        and quarantine any fingerprint that has now tripped breakers on
+        >= 2 DISTINCT worker incarnations. One worker tripping could be
+        that worker's hardware; the same fingerprint wrecking two
+        isolated stacks is the plan's fault — and with auto-respawn on,
+        NOT quarantining it turns the healer into a crash amplifier
+        (every replacement worker dies the same death)."""
+        for fp, reason in w.drain_trips():
+            if not fp:
+                continue            # trip outside any attribution scope
+            trippers = self._poison.get(fp)
+            if trippers is None:
+                trippers = set()
+            trippers.add(w.id)
+            self._poison[fp] = trippers     # (re)insert refreshes LRU
+            if len(trippers) >= 2 and fp not in self._quarantined:
+                self._quarantined[fp] = reason or "breaker"
+
+    def quarantined(self) -> Dict[str, str]:
+        """Snapshot of quarantined fingerprints -> trip reason."""
+        with self._lock:
+            return dict(self._quarantined)
+
+    def _maybe_respawn(self) -> List[str]:
+        """Top the fleet back up to `target_workers` (if respawn is
+        enabled), within the lifetime budget and the exponential
+        backoff. Each newborn gets the full gossip of every live peer's
+        stats observations — it joins knowing every observed cap and
+        high-water byte count the fleet has ever measured — and hot
+        fingerprints re-replicate so its ring arc is warm. Deferred
+        (budget- or backoff-blocked) attempts count under
+        `respawn_deferred`; the sweep retries them."""
+        spawned: List[str] = []
+        while True:
+            with self._lock:
+                if self._closed or not self.respawn:
+                    break
+                if len(self._routable_locked()) >= self.target_workers:
+                    break
+                if self.respawned >= self.respawn_max:
+                    self.respawn_deferred += 1
+                    break
+                now = time.monotonic()
+                base = self.respawn_backoff_ms / 1e3
+                # _respawn_last == 0.0 is the "never respawned" sentinel
+                # (monotonic's epoch is arbitrary): the first respawn is
+                # never backoff-gated
+                if base > 0 and self._respawn_last > 0.0:
+                    # a quiet fleet forgets its crash streak; a churning
+                    # one doubles its wait (capped) so a crash-looping
+                    # root cause cannot spin workers at full speed
+                    if now - self._respawn_last > 16 * base:
+                        self._respawn_streak = 0
+                    wait = base * (2 ** self._respawn_streak)
+                    if now - self._respawn_last < wait:
+                        self.respawn_deferred += 1
+                        break
+                wid = self._next_wid_locked()
+                w = self._add_worker_locked(
+                    wid, scheduler_kwargs=self._scheduler_kwargs)
+                self.respawned += 1
+                self._respawn_last = now
+                self._respawn_streak = min(self._respawn_streak + 1, 8)
+                rows = []
+                for peer in self._live_workers_locked():
+                    if peer is not w:
+                        rows.extend(peer.gossip_export())
+                if rows:
+                    w.gossip_merge(rows)
+                    self.gossips += 1
+                self._replicate_hot_locked()
+                spawned.append(wid)
+        return spawned
+
+    def _hot_fps_locked(self) -> Set[str]:
+        """Fingerprints worth replicating: >= 2 observed runs AND in
+        the top-`hot_k` by run count — one-shot plans are not worth a
+        replica slot, and K bounds replication work on wide traffic."""
+        import heapq
+        cand = [(n, fp) for fp, n in self._fp_runs.items() if n >= 2]
+        return {fp for _, fp in heapq.nlargest(self.hot_k, cand)}
+
+    def _replicate_locked(self, fp: str, digest: str) -> None:
+        """Warm failover: copy the frozen cache entry for (fp, digest)
+        onto the next `hot_replicas` distinct ring successors of `fp`'s
+        primary. When the primary dies, the ring rehomes `fp` to
+        exactly its first successor — which already holds the entry, so
+        the failover serves a hit instead of recompiling. Entries are
+        adopted frozen (shared, immutable) and TTL'd/invalidated like
+        any other entry: the bus drops primary AND replicas together."""
+        owners = self._ring.route_multi(fp, 1 + self.hot_replicas)
+        if len(owners) < 2:
+            return
+        key = (fp, digest)
+        ent, src = None, None
+        for w in self._live_workers_locked():
+            ent = w.scheduler.cache.peek_frozen(key)
+            if ent is not None:
+                src = w
+                break
+        if ent is None:
+            return                  # nothing computed/cached yet
+        for wid in owners[1:]:
+            w = self._workers.get(wid)
+            if w is None or not w.alive or w is src:
+                continue
+            if w.scheduler.cache.peek_frozen(key) is None:
+                w.scheduler.cache.adopt(key, ent[0], ent[1])
+                self.replications += 1
+
+    def _replicate_hot_locked(self) -> None:
+        """Re-derive replica placement for every hot fingerprint —
+        membership changed (join/respawn), so ring successor sets
+        changed with it (minimally: route_multi's walk)."""
+        if self.hot_replicas <= 0 or self.hot_k <= 0:
+            return
+        for fp in self._hot_fps_locked():
+            digest = self._digests.get(fp)
+            if digest is not None:
+                self._replicate_locked(fp, digest)
+
+    def _sweep_loop(self) -> None:
+        """Background health sweep: absorb trip logs (quarantine does
+        not wait for the next submission), reap stuck-open breakers,
+        and retry deferred respawns. Best-effort by design — a sweep
+        pass that loses a race with a concurrent kill just retries next
+        period."""
+        period = max(self.sweep_ms / 1e3, 1e-3)
+        while not self._sweep_stop.wait(period):
+            try:
+                with self._lock:
+                    if self._closed:
+                        return
+                    for w in self._live_workers_locked():
+                        self._absorb_trips_locked(w)
+                self.reap_unhealthy()
+                self._maybe_respawn()
+            except Exception:
+                pass                # the sweep must outlive any one bug
 
     # ---- sessions ----------------------------------------------------------
 
@@ -439,7 +818,7 @@ class FleetScheduler:
     # ---- routing -----------------------------------------------------------
 
     def _route_locked(self, rec: _SessRec, plan) -> FleetWorker:
-        live = self._live_workers_locked()
+        live = self._routable_locked()
         if not live:
             raise ServingRejectedError(
                 "closed", "no live workers", session=rec.id)
@@ -451,7 +830,7 @@ class FleetScheduler:
         # would reset them and un-bound the very storms they bound)
         if rec.affinity is not None:
             w = self._workers.get(rec.affinity)
-            if w is not None and w.alive and \
+            if w is not None and w.alive and not w.draining and \
                     any(not t.done() for t in rec.tickets):
                 self.routes_affinity += 1
                 return w
@@ -523,27 +902,56 @@ class FleetScheduler:
         # must see the digest the cache key will see, or it invalidates
         # on a phantom change
         digest = cache_mod.input_digest(bind_scan_sources(plan, inputs))
+        fp = plan.fingerprint
         with self._lock:
+            # quarantine arms WITH respawn (and only then): it exists
+            # to keep the healer from feeding a crash-amplifying plan
+            # to every replacement worker. A fleet without respawn
+            # keeps the pre-self-healing admission behavior (breaker
+            # trips degrade and recover per worker, nothing fleet-wide)
+            pin_cpu = False
+            if self.respawn:
+                # absorb attributed breaker trips BEFORE admission: a
+                # fingerprint that just earned its second distinct-
+                # worker trip must not be admitted a third time
+                for lw in self._live_workers_locked():
+                    self._absorb_trips_locked(lw)
+            if self.respawn and fp in self._quarantined:
+                self.quarantine_hits += 1
+                if self.quarantine_policy == "reject":
+                    raise ServingRejectedError(
+                        "quarantined",
+                        f"fingerprint {fp[:12]} tripped breakers on "
+                        f">= 2 distinct workers "
+                        f"({self._quarantined[fp]})", session=rec.id)
+                pin_cpu = True      # degrade: serve it, CPU tier only
+            self._fp_runs[fp] = self._fp_runs.get(fp, 0) + 1
             # the bus is CROSS-worker coherence: with one live worker
             # its own digest-keyed cache is already coherent, and bus
             # eviction would diverge from the single-worker scheduler's
             # behavior (the workers=1 byte-identical regression)
             if digest is not None and len(self._live_workers_locked()) > 1:
-                last = self._digests.get(plan.fingerprint)
+                last = self._digests.get(fp)
                 if last is not None and last != digest:
-                    self._publish_invalidation_locked(plan.fingerprint,
-                                                      digest)
-                self._digests[plan.fingerprint] = digest
+                    self._publish_invalidation_locked(fp, digest)
+                self._digests[fp] = digest
             w = self._route_locked(rec, plan)
             if digest is not None and len(self._workers) > 1:
-                self._promote_locked(w, (plan.fingerprint, digest))
+                self._promote_locked(w, (fp, digest))
+                # warm failover: a fingerprint that just became (or
+                # stays) hot keeps its frozen entry replicated on its
+                # ring successors
+                if (self.hot_replicas > 0 and self.hot_k > 0
+                        and self._fp_runs.get(fp, 0) >= 2
+                        and fp in self._hot_fps_locked()):
+                    self._replicate_locked(fp, digest)
             handle = self._handle_locked(rec, w)
             rec.tickets.add(ticket)
             if len(rec.tickets) > 64:
                 rec.tickets = {t for t in rec.tickets if not t.done()}
         try:
             inner = handle.submit(plan, inputs, block=block,
-                                  timeout=timeout)
+                                  timeout=timeout, pin_cpu=pin_cpu)
         except BaseException:
             # rejected at the worker's front door (queue_full /
             # over_quota / ...): the tenant sees the typed error — the
@@ -593,6 +1001,21 @@ class FleetScheduler:
             w0 = self._workers.get(cur_w)
             if w0 is not None and w0.alive and not ticket.done():
                 return
+            # a fingerprint quarantined AFTER the original submission
+            # replays under the quarantine policy — the whole point is
+            # that a replay of a worker-killer must not kill again
+            fp = ticket.plan.fingerprint
+            pin_cpu = False
+            if self.respawn and fp in self._quarantined:
+                self.quarantine_hits += 1
+                if self.quarantine_policy == "reject":
+                    ticket._fail(ServingRejectedError(
+                        "quarantined",
+                        f"fingerprint {fp[:12]} quarantined during "
+                        f"failover ({self._quarantined[fp]})",
+                        session=ticket.session))
+                    return
+                pin_cpu = True
             try:
                 w = self._route_locked(rec, ticket.plan)
             except ServingRejectedError as e:
@@ -602,7 +1025,8 @@ class FleetScheduler:
             self.replayed_jobs += 1
             ticket.replays += 1
         try:
-            inner = handle.submit(ticket.plan, ticket.inputs)
+            inner = handle.submit(ticket.plan, ticket.inputs,
+                                  pin_cpu=pin_cpu)
         except BaseException as e:
             ticket._fail(e)
             return
@@ -612,9 +1036,12 @@ class FleetScheduler:
 
     def close(self, drain: bool = True,
               timeout: Optional[float] = None) -> None:
+        self._sweep_stop.set()
         with self._lock:
             self._closed = True
             workers = list(self._workers.values())
+        if self._sweep_thread is not None:
+            self._sweep_thread.join(timeout=5.0)
         for w in workers:
             if w.alive:
                 w.scheduler.close(drain=drain, timeout=timeout)
@@ -638,10 +1065,23 @@ class FleetScheduler:
                         "failovers": self.failovers,
                         "replayed_jobs": self.replayed_jobs,
                         "bus_publishes": self.bus_publishes,
-                        "cache_promotions": self.cache_promotions}
+                        "cache_promotions": self.cache_promotions,
+                        # self-healing: failovers split by cause, plus
+                        # the healer's own bookkeeping
+                        "killed": self.killed,
+                        "reaped": self.reaped,
+                        "drained": self.drained,
+                        "respawned": self.respawned,
+                        "respawn_deferred": self.respawn_deferred,
+                        "replications": self.replications,
+                        "gossips": self.gossips,
+                        "quarantine_hits": self.quarantine_hits,
+                        "quarantined": sorted(self._quarantined),
+                        "target_workers": self.target_workers}
         out = {}
         for wid, w in workers.items():
             out[wid] = {"alive": w.alive,
+                        "draining": w.draining,
                         "pressure": w.pressure_score() if w.alive else None,
                         "serving": w.scheduler.metrics() if w.alive
                         else None}

@@ -91,6 +91,29 @@ class HashRing:
                 i = 0                          # wrap: the ring is a circle
             return self._owner[self._points[i]]
 
+    def route_multi(self, key: str, n: int) -> List[str]:
+        """The first `n` DISTINCT owners clockwise from `key`'s point —
+        primary first, then the replica owners warm failover replicates
+        hot entries to (serving/fleet.py). Same walk every quorum-style
+        ring uses: membership changes re-derive replica sets with
+        minimal remap (a join inserts itself into some sets, a leave
+        drops itself — surviving members keep their relative order,
+        which tests/test_fleet.py pins). Returns fewer than `n` names
+        when the ring has fewer members."""
+        with self._lock:
+            if not self._points or n <= 0:
+                return []
+            out: List[str] = []
+            start = bisect.bisect_right(self._points, _point(key))
+            for off in range(len(self._points)):
+                owner = self._owner[
+                    self._points[(start + off) % len(self._points)]]
+                if owner not in out:
+                    out.append(owner)
+                    if len(out) >= n:
+                        break
+            return out
+
     def members(self) -> Tuple[str, ...]:
         with self._lock:
             return tuple(sorted(self._members))

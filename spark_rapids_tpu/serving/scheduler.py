@@ -77,9 +77,11 @@ PRIORITIES = {"interactive": 0, "normal": 1, "batch": 2}
 
 class ServingRejectedError(RuntimeError):
     """Typed fast-reject from the serving layer. `reason` is machine-
-    checkable ("queue_full" | "over_quota" | "closed"); `session` and
-    `operator` (the label that set the certified peak, over-quota only)
-    make the diagnostic attributable without parsing the message."""
+    checkable ("queue_full" | "over_quota" | "closed" | "deadline" |
+    "quarantined" — the last from the fleet's poison-fingerprint gate,
+    serving/fleet.py); `session` and `operator` (the label that set the
+    certified peak, over-quota only) make the diagnostic attributable
+    without parsing the message."""
 
     def __init__(self, reason: str, detail: str, *,
                  session: Optional[str] = None, operator: str = ""):
@@ -105,9 +107,28 @@ class Ticket:
         self._event = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
+        # completion callbacks (serving/fleet.py condition-notify
+        # wakeup): own lock, never held while running a callback or
+        # while any other lock is held — no lock-order edges
+        self._cb_lock = threading.Lock()
+        self._callbacks: List = []
 
     def done(self) -> bool:
         return self._event.is_set()
+
+    def add_done_callback(self, fn) -> None:
+        """Run `fn(self)` when the ticket completes — immediately if it
+        already has. Callbacks run on the completing thread (or this
+        one), outside every scheduler lock; exceptions are swallowed
+        (a waiter's notification hook must never fail the job)."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        try:
+            fn(self)
+        except Exception:
+            pass
 
     def result(self, timeout: Optional[float] = None):
         if not self._event.wait(timeout):
@@ -121,7 +142,17 @@ class Ticket:
     def _complete(self, result=None, error: Optional[BaseException] = None):
         self._result = result
         self._error = error
-        self._event.set()
+        # set the event UNDER the callback lock: a concurrent
+        # add_done_callback either appends before the set (drained
+        # below) or observes it set and self-invokes — never neither
+        with self._cb_lock:
+            self._event.set()
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            try:
+                fn(self)
+            except Exception:
+                pass
 
 
 class _SessionState:
@@ -152,6 +183,7 @@ class _SessionState:
         self.degraded = 0
         self.retries = 0
         self.cache_hits = 0
+        self.deadline_rejects = 0            # expired-in-queue completions
         self.wait_ms: List[float] = []       # per-dispatch queue waits
         self.aged_dispatches = 0             # starvation-bound promotions
         self.active_jobs = 0                 # dispatched, not yet completed
@@ -169,11 +201,12 @@ class _SessionState:
 class _Job:
     __slots__ = ("plan", "inputs", "state", "ticket", "charge",
                  "charge_source", "op_label", "tier", "cache_key",
-                 "enqueued_at")
+                 "enqueued_at", "deadline")
 
     def __init__(self, plan, inputs, state: _SessionState, ticket: Ticket,
                  charge: int, charge_source: str, op_label: str, tier: str,
-                 cache_key, enqueued_at: float):
+                 cache_key, enqueued_at: float,
+                 deadline: Optional[float] = None):
         self.plan = plan
         self.inputs = inputs
         self.state = state
@@ -184,6 +217,7 @@ class _Job:
         self.tier = tier                  # "device" | "cpu" (quota-degraded)
         self.cache_key = cache_key
         self.enqueued_at = enqueued_at
+        self.deadline = deadline          # submit-side deadline (clock units)
 
 
 class ServingSession:
@@ -198,17 +232,21 @@ class ServingSession:
 
     def submit(self, plan, inputs: Optional[Dict] = None, *,
                block: Optional[bool] = None,
-               timeout: Optional[float] = None) -> Ticket:
+               timeout: Optional[float] = None,
+               pin_cpu: bool = False) -> Ticket:
         return self._scheduler._submit(self._state, plan, inputs,
-                                       block=block, timeout=timeout)
+                                       block=block, timeout=timeout,
+                                       pin_cpu=pin_cpu)
 
     def run(self, plan, inputs: Optional[Dict] = None, *,
             block: Optional[bool] = None,
-            timeout: Optional[float] = None):
+            timeout: Optional[float] = None,
+            pin_cpu: bool = False):
         """submit + wait under ONE deadline: whatever the blocked submit
         consumed of `timeout` is not granted to the result wait again."""
         t0 = time.monotonic()
-        ticket = self.submit(plan, inputs, block=block, timeout=timeout)
+        ticket = self.submit(plan, inputs, block=block, timeout=timeout,
+                             pin_cpu=pin_cpu)
         remaining = (None if timeout is None
                      else max(0.0, timeout - (time.monotonic() - t0)))
         return ticket.result(remaining)
@@ -404,7 +442,8 @@ class ServingScheduler:
         return None if obs is None else int(obs[0])
 
     def _submit(self, state: _SessionState, plan, inputs: Optional[Dict],
-                *, block: Optional[bool], timeout: Optional[float]) -> Ticket:
+                *, block: Optional[bool], timeout: Optional[float],
+                pin_cpu: bool = False) -> Ticket:
         from ..analysis.footprint import quota_charge
         if self._closed or state.closed:
             # early unlocked read: a submit racing close() is still
@@ -446,7 +485,12 @@ class ServingScheduler:
             source = "observed"
         ticket.charge_source = source
         tier = "device"
-        if charge > state.quota_bytes:
+        if pin_cpu:
+            # fleet quarantine degrade (serving/fleet.py): the device
+            # never sees this plan, so the device quota does not bind —
+            # the same contract as the over_quota degrade below
+            tier, charge = "cpu", 0
+        elif charge > state.quota_bytes:
             # can NEVER fit this session's quota: resolve now, before any
             # compilation — reject with an attributable diagnostic, or pin
             # to the CPU tier where the device quota does not bind
@@ -491,7 +535,8 @@ class ServingScheduler:
                         "closed", "session or scheduler shut down while "
                         "submit was blocked", session=state.id)
             job = _Job(plan, inputs, state, ticket, charge, source,
-                       op_label, tier, key, self._clock())
+                       op_label, tier, key, self._clock(),
+                       deadline=deadline)
             state.queue.append(job)
             state.submitted += 1
             self._queued += 1
@@ -622,6 +667,18 @@ class ServingScheduler:
         # dispatcher thread, leak _active/in_flight accounting (close()
         # then never drains), and strand the submitter's result() forever
         try:
+            # deadline enforcement at dispatch: a job whose submit-side
+            # deadline expired while QUEUED completes with the typed
+            # rejection before certification or compilation — nobody is
+            # waiting for the result, and executing it anyway would
+            # charge quota and burn a dispatcher slot for dead traffic.
+            # queue_wait_ms is already stamped above: the wait that
+            # killed the job is exactly the number worth reporting.
+            if job.deadline is not None and self._clock() >= job.deadline:
+                raise ServingRejectedError(
+                    "deadline",
+                    f"submit-side deadline expired after "
+                    f"{wait_ms:.0f} ms queued", session=state.id)
             # dispatch-time cache consult: a repeat plan that QUEUED
             # behind its twin (both submitted before either completed —
             # the common shape of a burst of identical traffic) still
@@ -644,7 +701,14 @@ class ServingScheduler:
                 scope = (stats_mod.scoped_store(self.stats_store)
                          if self.stats_store is not None
                          else contextlib.nullcontext())
-                with sessionctx.session_scope(state.id), scope:
+                # attribution scope: a breaker trip fired by THIS
+                # execution is stamped with this plan's fingerprint in
+                # the health monitor's trip log, which is what lets the
+                # fleet's poison-plan quarantine (serving/fleet.py)
+                # attribute trips to fingerprints instead of guessing
+                with sessionctx.session_scope(state.id), scope, \
+                        self.executor.health.attribution(
+                            job.plan.fingerprint):
                     result = self.executor.execute(
                         job.plan, job.inputs,
                         tier="cpu" if job.tier == "cpu" else None)
@@ -686,6 +750,13 @@ class ServingScheduler:
                                 state.cost_at = self._clock()
                             state.cost_score += float(result.wall_ms) + \
                                 self._FEEDBACK_RETRY_MS * result.retries
+                elif (isinstance(error, ServingRejectedError)
+                      and error.reason == "deadline"):
+                    # expired-in-queue is an admission outcome, not an
+                    # execution failure: count it with the rejects so
+                    # `failed` keeps meaning "execution broke"
+                    state.rejected += 1
+                    state.deadline_rejects += 1
                 else:
                     state.failed += 1
                 self._maybe_reap_locked(state)
@@ -701,6 +772,7 @@ class ServingScheduler:
         `ServingRejectedError("closed")` immediately. Either way no new
         submission is accepted from the moment of the call."""
         deadline = None if timeout is None else self._clock() + timeout
+        doomed: List[_Job] = []
         with self._lock_cond:
             self._closed = True
             if not drain:
@@ -708,10 +780,16 @@ class ServingScheduler:
                     while state.queue:
                         job = state.queue.popleft()
                         self._queued -= 1
-                        job.ticket._complete(error=ServingRejectedError(
-                            "closed", "scheduler shut down before "
-                            "dispatch", session=state.id))
+                        doomed.append(job)
             self._lock_cond.notify_all()
+        # complete OUTSIDE the scheduler lock: _complete runs done-
+        # callbacks (fleet ticket wakeups), and callbacks under the
+        # scheduler lock would hand arbitrary code a lock-order edge
+        for job in doomed:
+            job.ticket._complete(error=ServingRejectedError(
+                "closed", "scheduler shut down before dispatch",
+                session=job.state.id))
+        with self._lock_cond:
             while self._queued > 0 or self._active > 0:
                 remaining = (None if deadline is None
                              else deadline - self._clock())
@@ -741,6 +819,7 @@ class ServingScheduler:
                        "queued": len(s.queue), "submitted": s.submitted,
                        "completed": s.completed, "failed": s.failed,
                        "rejected": s.rejected, "degraded": s.degraded,
+                       "deadline_rejects": s.deadline_rejects,
                        "retries": s.retries, "cache_hits": s.cache_hits,
                        "aged_dispatches": s.aged_dispatches,
                        "cost_score": round(s.cost_score, 3),

@@ -405,6 +405,342 @@ def test_bus_keeps_new_digest_entry_sound():
         assert res.table.to_pydict() == _solo(p, t_new)
 
 
+# ---- self-healing (docs/serving.md#fleet-self-healing) ----------------------
+
+def _trip_attributed(w, fp):
+    """Trip `w`'s breaker with `fp` installed as the thread's trip
+    attribution — the shape the dispatcher produces when an execution
+    of `fp` faults fatally on worker `w`."""
+    with w.health.attribution(fp):
+        w.health.trip("fatal", RuntimeError("forced"))
+
+
+def test_respawn_restores_fleet_size_with_fresh_id():
+    with FleetScheduler(workers=2, respawn=True, respawn_backoff_ms=0,
+                        scheduler_kwargs={"workers": 1}) as fleet:
+        fleet.kill_worker("w0")
+        m = fleet.metrics()
+        assert m["killed"] == 1 and m["respawned"] == 1
+        # monotonic id: the replacement is w2, never a recycled w0 —
+        # quarantine counts trips per worker INCARNATION
+        assert sorted(m["ring"]) == ["w1", "w2"]
+        assert not fleet._workers["w0"].alive
+        # the newborn actually serves, ring-routed
+        s = fleet.open_session("a")
+        p, t = _plan_homed_at(fleet, "w2"), _table()
+        tk = s.submit(p, {"t": t})
+        assert tk.result(timeout=120).table.to_pydict() == _solo(p, t)
+        assert tk.worker == "w2"
+
+
+def test_respawn_budget_and_backoff_defer():
+    # budget: respawn_max=1 -> the second death is not replaced
+    with FleetScheduler(workers=3, respawn=True, respawn_max=1,
+                        respawn_backoff_ms=0,
+                        scheduler_kwargs={"workers": 1}) as fleet:
+        fleet.kill_worker("w0")
+        fleet.kill_worker("w1")
+        m = fleet.metrics()
+        assert m["respawned"] == 1 and m["respawn_deferred"] >= 1
+        assert len(m["ring"]) == 2
+    # backoff: a huge base defers the SECOND respawn (never the first)
+    with FleetScheduler(workers=3, respawn=True,
+                        respawn_backoff_ms=3_600_000.0,
+                        scheduler_kwargs={"workers": 1}) as fleet:
+        fleet.kill_worker("w0")
+        assert fleet.metrics()["respawned"] == 1
+        fleet.kill_worker("w1")
+        m = fleet.metrics()
+        assert m["respawned"] == 1 and m["respawn_deferred"] >= 1
+
+
+def test_respawn_off_keeps_legacy_shrink():
+    with FleetScheduler(workers=2,
+                        scheduler_kwargs={"workers": 1}) as fleet:
+        fleet.kill_worker("w0")
+        m = fleet.metrics()
+        assert m["respawned"] == 0 and m["ring"] == ["w1"]
+
+
+def test_drain_worker_finishes_inflight_no_replay():
+    gate = threading.Event()
+    with FleetScheduler(workers=2, respawn=True, respawn_backoff_ms=0,
+                        scheduler_kwargs={"cache_entries": 0,
+                                          "workers": 1}) as fleet:
+        _gate_workers(fleet, gate)
+        s = fleet.open_session("a")
+        t = _table()
+        plans = [_plan(thr) for thr in range(3)]
+        tickets = [s.submit(p, {"t": t}) for p in plans]
+        victim = tickets[0].worker
+        releaser = threading.Timer(0.3, gate.set)
+        releaser.start()
+        try:
+            stragglers = fleet.drain_worker(victim, timeout=60)
+        finally:
+            releaser.join()
+        # the drain WAITED: everything finished on the drainee, nothing
+        # replayed, no failover_reason stamped
+        assert stragglers == 0
+        for tk, p in zip(tickets, plans):
+            assert tk.result(timeout=120).table.to_pydict() == _solo(p, t)
+            assert tk.replays == 0 and tk.failover_reason == ""
+        m = fleet.metrics()
+        assert m["drained"] == 1 and m["killed"] == 0
+        assert m["respawned"] == 1 and len(m["ring"]) == 2
+        assert not fleet._workers[victim].alive
+
+
+def test_drain_deadline_replays_stragglers_with_reason():
+    gate = threading.Event()
+    with FleetScheduler(workers=2,
+                        scheduler_kwargs={"cache_entries": 0,
+                                          "workers": 1}) as fleet:
+        _gate_workers(fleet, gate)
+        s = fleet.open_session("a")
+        t = _table()
+        plans = [_plan(thr) for thr in range(3)]
+        tickets = [s.submit(p, {"t": t}) for p in plans]
+        victim = tickets[0].worker
+        # deadline fires while the gate still holds every execution:
+        # all three are stragglers and replay on the survivor
+        stragglers = fleet.drain_worker(victim, timeout=0.2)
+        gate.set()
+        assert stragglers == 3
+        for tk, p in zip(tickets, plans):
+            assert tk.result(timeout=120).table.to_pydict() == _solo(p, t)
+            assert tk.failover_reason == "drained"
+        assert fleet.metrics()["drained"] == 1
+
+
+def test_kill_stamps_failover_reason():
+    gate = threading.Event()
+    with FleetScheduler(workers=2,
+                        scheduler_kwargs={"cache_entries": 0,
+                                          "workers": 1}) as fleet:
+        _gate_workers(fleet, gate)
+        s = fleet.open_session("a")
+        t, p = _table(), _plan(3)
+        tk = s.submit(p, {"t": t})
+        releaser = threading.Timer(0.2, gate.set)
+        releaser.start()
+        try:
+            fleet.kill_worker(tk.worker)
+        finally:
+            releaser.join()
+        assert tk.result(timeout=120).table.to_pydict() == _solo(p, t)
+        assert tk.failover_reason in ("killed", "")  # "" iff it finished
+        assert tk.failover_reason == "killed" or tk.replays == 0
+
+
+def test_poison_quarantine_needs_two_distinct_workers():
+    p, t = _plan(9), _table()
+    fp = p.fingerprint
+    # one worker tripping twice is NOT a poison verdict (could be that
+    # worker's hardware) — two distinct incarnations is
+    with FleetScheduler(workers=3, respawn=True, respawn_backoff_ms=0,
+                        quarantine="reject",
+                        scheduler_kwargs={"workers": 1}) as fleet:
+        s = fleet.open_session("a")
+        _trip_attributed(fleet._workers["w0"], fp)
+        _trip_attributed(fleet._workers["w0"], fp)
+        tk = s.submit(p, {"t": t})          # absorbs trips; still admits
+        assert tk.result(timeout=120).table.to_pydict() == _solo(p, t)
+        assert fp not in fleet.quarantined()
+        _trip_attributed(fleet._workers["w1"], fp)
+        from spark_rapids_tpu.serving.scheduler import ServingRejectedError
+        with pytest.raises(ServingRejectedError) as ei:
+            s.submit(p, {"t": t})
+        assert ei.value.reason == "quarantined"
+        assert fp in fleet.quarantined()
+        assert fleet.metrics()["quarantine_hits"] >= 1
+        # other fingerprints keep serving
+        q = _plan(77)
+        assert s.run(q, {"t": t}).table.to_pydict() == _solo(q, t)
+
+
+def test_poison_quarantine_degrade_pins_cpu():
+    p, t = _plan(9), _table()
+    fp = p.fingerprint
+    with FleetScheduler(workers=3, respawn=True, respawn_backoff_ms=0,
+                        quarantine="degrade",
+                        scheduler_kwargs={"workers": 1}) as fleet:
+        s = fleet.open_session("a")
+        _trip_attributed(fleet._workers["w0"], fp)
+        _trip_attributed(fleet._workers["w1"], fp)
+        tk = s.submit(p, {"t": t})
+        assert tk.result(timeout=120).table.to_pydict() == _solo(p, t)
+        assert fleet.metrics()["quarantine_hits"] >= 1
+        # CPU pin shows up as a degraded completion on the worker
+        m = fleet.metrics()
+        degraded = sum(
+            sd["sessions"]["a"]["degraded"]
+            for sd in (w["serving"] for w in m["workers"].values())
+            if sd and "a" in sd["sessions"])
+        assert degraded >= 1
+
+
+def test_quarantine_unarmed_without_respawn():
+    p, t = _plan(9), _table()
+    with FleetScheduler(workers=3, quarantine="reject",
+                        scheduler_kwargs={"workers": 1}) as fleet:
+        s = fleet.open_session("a")
+        _trip_attributed(fleet._workers["w0"], p.fingerprint)
+        _trip_attributed(fleet._workers["w1"], p.fingerprint)
+        # respawn off -> pre-self-healing admission behavior
+        assert s.run(p, {"t": t}).table.to_pydict() == _solo(p, t)
+
+
+def test_hot_replication_to_ring_successor():
+    with FleetScheduler(workers=3, hot_replicas=1, hot_k=4,
+                        scheduler_kwargs={"workers": 1}) as fleet:
+        p, t = _plan(15), _table()
+        s = fleet.open_session("a")
+        s.run(p, {"t": t})
+        assert fleet.metrics()["replications"] == 0, \
+            "one run must not replicate (not hot yet)"
+        s.run(p, {"t": t})                  # second run -> hot
+        assert fleet.metrics()["replications"] >= 1
+        owners = fleet._ring.route_multi(p.fingerprint, 2)
+        from spark_rapids_tpu.serving.cache import cache_key
+        key = cache_key(p, {"t": t})
+        replica = fleet._workers[owners[1]]
+        assert replica.scheduler.cache.peek_frozen(key) is not None
+        # the home dies: the rehomed submission is a replica HIT
+        fleet.kill_worker(owners[0])
+        tk = s.submit(p, {"t": t})
+        res = tk.result(timeout=120)
+        assert tk.cached and res.table.to_pydict() == _solo(p, t)
+        assert tk.worker == owners[1]
+
+
+def test_replicas_honor_invalidation_bus():
+    with FleetScheduler(workers=3, hot_replicas=2, hot_k=4,
+                        scheduler_kwargs={"workers": 1}) as fleet:
+        p = _plan(15)
+        t_old, t_new = _table(seed=0), _table(seed=7)
+        s = fleet.open_session("a")
+        s.run(p, {"t": t_old})
+        s.run(p, {"t": t_old})              # hot -> replicated fleetwide
+        assert fleet.metrics()["replications"] >= 2
+        # digest change: primary AND replicas drop the old entries
+        res = s.run(p, {"t": t_new})
+        assert res.table.to_pydict() == _solo(p, t_new)
+        from spark_rapids_tpu.serving.cache import cache_key
+        old_key = cache_key(p, {"t": t_old})
+        for w in fleet._workers.values():
+            assert w.scheduler.cache.peek_frozen(old_key) is None, \
+                f"stale replica survived the bus on {w.id}"
+
+
+def test_replicas_honor_ttl():
+    clock = {"t": 0.0}
+    with FleetScheduler(
+            workers=3, hot_replicas=1, hot_k=4,
+            scheduler_kwargs={"workers": 1, "cache_ttl_s": 10.0,
+                              "clock": lambda: clock["t"]}) as fleet:
+        p, t = _plan(15), _table()
+        s = fleet.open_session("a")
+        s.run(p, {"t": t})
+        s.run(p, {"t": t})                  # replicated
+        owners = fleet._ring.route_multi(p.fingerprint, 2)
+        from spark_rapids_tpu.serving.cache import cache_key
+        key = cache_key(p, {"t": t})
+        replica = fleet._workers[owners[1]]
+        assert replica.scheduler.cache.peek_frozen(key) is not None
+        clock["t"] += 11.0                  # past the replica's TTL
+        assert replica.scheduler.cache.peek_frozen(key) is None, \
+            "an expired replica must not serve"
+        fleet.kill_worker(owners[0])
+        tk = s.submit(p, {"t": t})
+        res = tk.result(timeout=120)
+        assert not tk.cached, "expired replica served a hit"
+        assert res.table.to_pydict() == _solo(p, t)
+
+
+def test_route_multi_minimal_remap_on_membership_change():
+    ring = HashRing(replicas=64)
+    for w in ("w0", "w1", "w2", "w3"):
+        ring.add(w)
+    keys = [f"fp-{i}" for i in range(200)]
+    before = {k: ring.route_multi(k, 2) for k in keys}
+    ring.remove("w1")
+    after = {k: ring.route_multi(k, 2) for k in keys}
+    for k in keys:
+        survivors = [w for w in before[k] if w != "w1"]
+        # surviving members keep their relative order; the set only
+        # gains members appended by the walk reaching further
+        assert after[k][:len(survivors)] == survivors, \
+            f"{k}: {before[k]} -> {after[k]} reordered survivors"
+    ring.add("w1")
+    assert {k: ring.route_multi(k, 2) for k in keys} == before
+    # n larger than membership: every member once, no padding
+    assert sorted(ring.route_multi("x", 99)) == ["w0", "w1", "w2", "w3"]
+
+
+def test_kill_gossips_observed_stats_to_survivors():
+    with FleetScheduler(workers=2, hot_k=0,
+                        scheduler_kwargs={"workers": 1}) as fleet:
+        t = _table()
+        victim = "w0"
+        p = _plan_homed_at(fleet, victim)
+        s = fleet.open_session("a")
+        s.run(p, {"t": t})                  # observed stats land on w0
+        fleet.kill_worker(victim)
+        assert fleet.metrics()["gossips"] >= 1
+        # rehomed: no cache (the victim's died with it), but the
+        # survivor's stats store already KNOWS the plan — admission
+        # charges observed bytes and compilation is one-shot
+        tk = s.submit(p, {"t": t})
+        res = tk.result(timeout=120)
+        assert not tk.cached
+        assert tk.charge_source == "observed"
+        assert res.attempts == 1
+        assert res.table.to_pydict() == _solo(p, t)
+
+
+def test_respawned_worker_inherits_gossip():
+    with FleetScheduler(workers=2, hot_k=0, respawn=True,
+                        respawn_backoff_ms=0,
+                        scheduler_kwargs={"workers": 1}) as fleet:
+        t = _table()
+        p = _plan_homed_at(fleet, "w0")
+        s = fleet.open_session("a")
+        s.run(p, {"t": t})
+        fleet.kill_worker("w0")             # respawns w2, full gossip
+        import jax
+        backend = jax.default_backend()
+        w2 = fleet._workers["w2"]
+        assert w2.stats.observed_peak_bytes(backend, p.fingerprint) \
+            is not None, "the newborn joined without the fleet's memory"
+
+
+def test_fleet_ticket_condition_wakeup_no_polling_lag():
+    gate = threading.Event()
+    with FleetScheduler(workers=2,
+                        scheduler_kwargs={"cache_entries": 0,
+                                          "workers": 1}) as fleet:
+        _gate_workers(fleet, gate)
+        s = fleet.open_session("a")
+        p, t = _plan(3), _table()
+        tk = s.submit(p, {"t": t})
+        got = {}
+
+        def waiter():
+            got["res"] = tk.result(timeout=30)
+        th = threading.Thread(target=waiter)
+        th.start()
+        gate.set()
+        th.join(timeout=10)
+        assert not th.is_alive() and \
+            got["res"].table.to_pydict() == _solo(p, t)
+        # bounded timeout still raises promptly on an unbound ticket
+        from spark_rapids_tpu.serving.fleet import FleetTicket
+        empty = FleetTicket(fleet, "s", p, None)
+        with pytest.raises(TimeoutError):
+            empty.result(timeout=0.05)
+
+
 def test_ticket_fail_is_visible_to_concurrent_done():
     """FleetTicket._fail writes under the ticket lock (the lockdep tier
     caught the original lock-free write): once _fail returns, EVERY

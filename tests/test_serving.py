@@ -292,6 +292,82 @@ def test_quota_admits_within_bound():
         assert s.run(plan, {"t": t}, timeout=120) is not None
 
 
+# ---- submit-side deadlines / ticket callbacks -------------------------------
+
+def test_deadline_expired_in_queue_rejects_typed_before_compilation():
+    plan, t = _plan(), _table()
+    calls = []
+
+    class _Spy(_GateExecutor):
+        def _execute(self, *a, **kw):
+            calls.append(1)
+            return super()._execute(*a, **kw)
+
+    ex = _Spy(hold=1, mode="eager")
+    with ServingScheduler(ex, workers=1, cache_entries=0) as sched:
+        s = sched.open_session("s")
+        head = s.submit(plan, {"t": t})           # dispatched (gated)
+        ex.wait_dispatched(1)
+        doomed = s.submit(plan, {"t": t}, timeout=0.05)
+        time.sleep(0.15)                          # deadline passes queued
+        ex.gate.set()
+        assert head.result(timeout=120) is not None
+        with pytest.raises(ServingRejectedError) as ei:
+            doomed.result(timeout=120)
+        assert ei.value.reason == "deadline"
+        assert ei.value.session == "s"
+        assert len(calls) == 1, \
+            "an expired job must never reach an execution tier"
+        assert doomed.queue_wait_ms > 0
+        m = sched.metrics()["sessions"]["s"]
+        assert m["deadline_rejects"] == 1
+        assert m["rejected"] == 1
+        assert m["failed"] == 0, \
+            "a caller-imposed deadline is not a scheduler failure"
+
+
+def test_generous_deadline_still_executes():
+    plan, t = _plan(), _table()
+    with ServingScheduler(workers=1, cache_entries=0) as sched:
+        s = sched.open_session("s")
+        res = s.run(plan, {"t": t}, timeout=120)
+        assert res is not None
+        m = sched.metrics()["sessions"]["s"]
+        assert m["deadline_rejects"] == 0 and m["completed"] == 1
+
+
+def test_ticket_done_callbacks_fire_once_outside_locks():
+    plan, t = _plan(), _table()
+    ex = _GateExecutor(hold=1, mode="eager")
+    fired = []
+    with ServingScheduler(ex, workers=1, cache_entries=0) as sched:
+        s = sched.open_session("s")
+        tk = s.submit(plan, {"t": t})
+        ex.wait_dispatched(1)
+        # pre-completion registration: fires on complete, ticket arg
+        tk.add_done_callback(lambda tkt: fired.append(("pre", tkt.done())))
+        tk.add_done_callback(lambda tkt: 1 / 0)    # swallowed, not fatal
+        ex.gate.set()
+        assert tk.result(timeout=120) is not None
+        t0 = time.monotonic()
+        while len(fired) < 1 and time.monotonic() - t0 < 5:
+            time.sleep(0.005)
+        assert fired == [("pre", True)]
+        # post-completion registration: fires immediately, same thread
+        tk.add_done_callback(lambda tkt: fired.append(("post", tkt.done())))
+        assert fired == [("pre", True), ("post", True)]
+
+
+def test_pin_cpu_submit_runs_cpu_tier_with_parity():
+    plan, t = _plan(), _table()
+    ref = PlanExecutor(mode="eager").execute(plan, {"t": t}).table.to_pydict()
+    with ServingScheduler(workers=1, cache_entries=0) as sched:
+        s = sched.open_session("s")
+        res = s.run(plan, {"t": t}, timeout=120, pin_cpu=True)
+        assert res.degraded and res.table.to_pydict() == ref
+        assert sched.metrics()["sessions"]["s"]["degraded"] == 1
+
+
 # ---- result cache -----------------------------------------------------------
 
 def test_cache_hit_parity_copy_isolation_and_stamp():

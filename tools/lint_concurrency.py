@@ -86,7 +86,12 @@ _COND_CTOR = "Condition"
 # {gateway attr: allowed methods through it}, owned mutable state)
 _ISOLATION = {
     "FleetWorker": {
-        "surface": {"id", "alive", "pressure_score"},
+        # draining + the gossip/trip wrappers are the self-healing
+        # surface (serving/fleet.py): cross-worker stats exchange and
+        # trip attribution go through the worker's OWN methods, never
+        # through raw reaches into its stats/health internals
+        "surface": {"id", "alive", "draining", "pressure_score",
+                    "drain_trips", "gossip_export", "gossip_merge"},
         "via": {"scheduler": {"open_session", "close", "metrics",
                               "pressure"}},
         "owned": {"executor", "stats", "health"},

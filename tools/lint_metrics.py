@@ -33,6 +33,11 @@ Two AST rules over ``benchmarks/`` and ``bench.py``:
   — a fleet completion without the worker that served it cannot be
   attributed across the failover/replay trajectory the number exists
   to describe (docs/serving.md#fleet).
+- ``missing-respawn-stamp``: a call that stamps ``respawns=`` (a
+  self-healing record, serving/fleet.py) must also stamp ``worker_id=``
+  — a respawn count that does not name the replacement worker cannot
+  be joined against the membership change it claims happened
+  (docs/serving.md#fleet-self-healing).
 - ``raw-jsonl-missing-stamp``: a ``json.dumps({...literal...})`` record
   must carry ``"backend"`` and ``"kernels"`` keys — unless it carries an
   ``"error"`` key (failure records describe infrastructure, not
@@ -118,6 +123,14 @@ def _lint_file(path: str, rel: str, findings: List[str]) -> None:
                     "fleet-layer completion without the worker that "
                     "served it is not attributable across failover "
                     "(serving/fleet.py, docs/serving.md#fleet)")
+            if "respawns" in kw and "worker_id" not in kw:
+                findings.append(
+                    f"{rel}:{node.lineno}: [missing-respawn-stamp] "
+                    f"{name}() stamps respawns= without worker_id= — a "
+                    "self-healing record that does not name the "
+                    "replacement worker cannot be joined against the "
+                    "respawn it claims happened "
+                    "(serving/fleet.py, docs/serving.md#fleet-self-healing)")
         elif name == "dumps" and node.args and \
                 isinstance(node.args[0], ast.Dict):
             keys = {k.value for k in node.args[0].keys
