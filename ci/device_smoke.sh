@@ -1,0 +1,66 @@
+#!/bin/bash
+# One-command live-backend smoke (ROADMAP item 5a): the day a real
+# accelerator is reachable, run every gate whose nightly form is
+# interpret-mode/simulated-mesh parity — so all the "remaining headroom:
+# real-TPU numbers" items in docs/kernels.md, docs/distributed.md, and
+# docs/optimizer.md#placement resolve in ONE run:
+#
+#   1. kernel_bench      — Mosaic lowerings interpret=False; the capped-
+#                          tier speedup gate ARMS itself on a tpu backend
+#                          (benchmarks/kernel_bench.py SPEEDUP_MIN)
+#   2. distributed_parity — NDS q5/q72 SPMD on the real mesh (no
+#                          --xla_force_host_platform_device_count: the
+#                          bench only injects simulated devices when the
+#                          flag is absent AND only the host platform
+#                          grows them — a tpu backend keeps its chips)
+#   3. exchange_bench    — packing + async dispatch on real ICI, where
+#                          wire bytes stop being simulated
+#   4. coplace_bench     — the STRICT co-placement gate: on a non-cpu
+#                          backend the host threads are different silicon
+#                          from the device walk, so warm placed wall <=
+#                          warm device-only wall is enforced, not just
+#                          the reported ratio (docs/optimizer.md#placement)
+#
+# Backend selection is left to jax (NO JAX_PLATFORMS=cpu, no --cpu):
+# whatever live device the environment exposes is what gets measured.
+# Like ci/tpu-smoke.sh, a dead axon tunnel is infrastructure, not a
+# failure: probe healthz first and exit 75 (EX_TEMPFAIL) so CI can tell
+# "tunnel dead" from "device regression". A backend that initializes to
+# cpu anyway (no device plugged) exits 75 for the same reason.
+set -u
+cd "$(dirname "$0")/.."
+
+up=""
+for p in 8090 8091 8092 8093 8094; do
+  if curl -s -m 5 "http://127.0.0.1:$p/healthz" >/dev/null 2>&1; then up=$p; break; fi
+done
+if [ -z "$up" ]; then
+  echo "device-smoke: axon tunnel unreachable (healthz dead on 8090-8094); skipping" >&2
+  exit 75   # EX_TEMPFAIL: infrastructure, not a test failure
+fi
+
+backend=$(timeout 120 python -c "import jax; print(jax.default_backend())" 2>/dev/null)
+if [ "${backend:-cpu}" = "cpu" ] || [ -z "${backend:-}" ]; then
+  echo "device-smoke: no live accelerator backend (got '${backend:-none}'); skipping" >&2
+  exit 75
+fi
+ndev=$(timeout 120 python -c "import jax; print(len(jax.devices()))")
+echo "device-smoke: backend=$backend n_devices=$ndev" >&2
+
+set -e
+SCALE="${DEVICE_SMOKE_SCALE:-0.2}"
+timeout "${DEVICE_SMOKE_TIMEOUT:-3600}" \
+  python benchmarks/kernel_bench.py --scale "$SCALE"
+if [ "$ndev" -ge 4 ]; then
+  timeout "${DEVICE_SMOKE_TIMEOUT:-3600}" \
+    python benchmarks/distributed_parity.py --scale "$SCALE"
+  timeout "${DEVICE_SMOKE_TIMEOUT:-3600}" \
+    python benchmarks/exchange_bench.py --scale "$SCALE"
+else
+  # the mesh tiers need >= 4 chips; a 1-chip smoke still proves the
+  # kernel + co-placement gates, so report the gap instead of failing
+  echo "device-smoke: $ndev device(s) < 4 — skipping distributed_parity/exchange_bench (mesh tiers)" >&2
+fi
+timeout "${DEVICE_SMOKE_TIMEOUT:-3600}" \
+  python benchmarks/coplace_bench.py --scale "$SCALE"
+echo "device-smoke OK (backend=$backend n_devices=$ndev)"

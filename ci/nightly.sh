@@ -72,6 +72,17 @@ JAX_PLATFORMS=cpu python benchmarks/optimizer_parity.py --scale 0.1 --cpu
 # build-side rewrite fired warm (through verify_rewrite), and warm wall
 # <= cold wall; every JSONL row carries adaptive/stats_hits stamps
 JAX_PLATFORMS=cpu python benchmarks/adaptive_bench.py --scale 0.1 --cpu
+# co-placement gate (docs/optimizer.md#placement): NDS q5/q72 eager tier,
+# placement rule off vs on, cold then warm under fresh stats stores —
+# bit-exact parity on == off, q5 declines its DAG-shared date dimension
+# (zero placed ops), q72 places its hd/dates build sides with measured
+# placement_overlap_ms > 0, and the warm-on/warm-off wall ratio is
+# reported to JSONL (gated strictly only on a real device backend, where
+# the host threads are different silicon — ci/device_smoke.sh; on this
+# CPU runner the ratio is bounded <= 1.5x against serialization
+# regressions); rows stamp placement/placement_overlap_ms alongside
+# backend+session (lint_metrics missing-placement-stamp rule)
+JAX_PLATFORMS=cpu python benchmarks/coplace_bench.py --scale 0.1 --cpu
 # streaming-scan gate (docs/io.md): parquet-bound vs table-bound parity in
 # both tiers, nonzero row groups pruned on a selective predicate (with
 # measurably fewer decoded bytes), and decode/execute overlap > 0 with the

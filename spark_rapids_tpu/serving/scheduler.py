@@ -32,10 +32,13 @@ across tenants while every per-tenant bound stays per-tenant:
   is stamped on the ticket (`charge_source`) and the soak's JSONL. A
   charge that can NEVER fit the session quota rejects (typed, naming
   session + the operator that set the certified peak, before any
-  compilation) or pins the plan to the CPU tier, per
-  `SPARK_RAPIDS_TPU_SERVING_OVER_QUOTA`; a charge that fits but is
-  currently crowded out just waits — the dispatcher skips the session
-  until its in-flight charges drain;
+  compilation), pins the plan to the CPU tier, or — under
+  `SPARK_RAPIDS_TPU_SERVING_OVER_QUOTA=partial` — offloads certified
+  join build-side subtrees to co-placement host threads until the
+  device remainder fits, charging quota for the device footprint only
+  (docs/serving.md#partial-placement, `charge_source="partial"`); a
+  charge that fits but is currently crowded out just waits — the
+  dispatcher skips the session until its in-flight charges drain;
 - **backpressure** — the queue is bounded; a full queue blocks submit()
   (or fast-rejects, caller-selectable) instead of hiding overload until
   memory does the rejecting (StreamBox-HBM's bounded-pipeline
@@ -103,6 +106,9 @@ class Ticket:
         self.queue_wait_ms: float = 0.0
         self.cached = False
         self.charge_source = ""   # "observed" | "certified" | "default"
+        #                           | "partial" (over-quota split:
+        #                           device-footprint charge only,
+        #                           docs/serving.md#partial-placement)
         self.worker = ""          # fleet worker id ("" single-worker)
         self._event = threading.Event()
         self._result = None
@@ -201,12 +207,13 @@ class _SessionState:
 class _Job:
     __slots__ = ("plan", "inputs", "state", "ticket", "charge",
                  "charge_source", "op_label", "tier", "cache_key",
-                 "enqueued_at", "deadline")
+                 "enqueued_at", "deadline", "placement")
 
     def __init__(self, plan, inputs, state: _SessionState, ticket: Ticket,
                  charge: int, charge_source: str, op_label: str, tier: str,
                  cache_key, enqueued_at: float,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 placement=None):
         self.plan = plan
         self.inputs = inputs
         self.state = state
@@ -218,6 +225,10 @@ class _Job:
         self.cache_key = cache_key
         self.enqueued_at = enqueued_at
         self.deadline = deadline          # submit-side deadline (clock units)
+        self.placement = placement        # host-placed subtree labels under
+        #                                   OVER_QUOTA=partial (None normal):
+        #                                   `charge` covers the DEVICE
+        #                                   remainder only
 
 
 class ServingSession:
@@ -317,10 +328,10 @@ class ServingScheduler:
                                      else int(default_charge_bytes))
         self.over_quota = (config.serving_over_quota()
                            if over_quota is None else over_quota)
-        if self.over_quota not in ("reject", "degrade"):
+        if self.over_quota not in ("reject", "degrade", "partial"):
             raise ValueError(f"unknown over_quota policy "
-                             f"{self.over_quota!r} (expected reject or "
-                             "degrade)")
+                             f"{self.over_quota!r} (expected reject, "
+                             "degrade, or partial)")
         bp = (config.serving_backpressure() if backpressure is None
               else backpressure)
         if bp not in ("block", "reject"):
@@ -441,6 +452,98 @@ class ServingScheduler:
             return None
         return None if obs is None else int(obs[0])
 
+    def _partial_placement(self, plan, inputs, cert, quota_bytes):
+        """Over-quota split under SPARK_RAPIDS_TPU_SERVING_OVER_QUOTA=
+        partial (docs/serving.md#partial-placement): offload certified
+        join build-side subtrees of the AUTHORED plan to co-placement
+        host worker threads — largest certified residency first — until
+        the certified peak of the DEVICE-placed remainder fits the
+        session quota. Returns (host subtree root labels, device
+        charge) or None when no split fits (the caller falls back to
+        the whole-plan CPU pin).
+
+        The candidate shape mirrors the optimizer's placement rule
+        (plan/optimizer.py): a HashJoin build (right) side of >= 2
+        nodes, no Exchange, every Scan bound to a Table, exclusive (one
+        consumer). The executor re-validates each label against the
+        OPTIMIZED plan and skips any the rewrite renamed — execution
+        stays correct either way; only the offload (and with it the
+        accounting's tightness) is lost, so build-side roots that
+        survive rewrites (Filter, HashAggregate) make the best
+        candidates. Defensive None on any error: admission sizing must
+        never fail a submission."""
+        from ..columnar import Table
+        from ..plan.nodes import Exchange, HashJoin, Scan
+        try:
+            if cert is None or cert.peak_bytes_hi is None:
+                return None
+            parents: Dict[int, List] = {}
+            for n in plan.nodes:
+                for c in n.children:
+                    parents.setdefault(id(c), []).append(n)
+            cands = []          # (root label, member labels, weight)
+            claimed: set = set()
+            for n in plan.nodes:
+                if not isinstance(n, HashJoin):
+                    continue
+                cand = n.children[1]
+                sub, seen = [], set()
+
+                def walk(x):
+                    if id(x) in seen:
+                        return
+                    seen.add(id(x))
+                    for c in x.children:
+                        walk(c)
+                    sub.append(x)
+
+                walk(cand)
+                ids = {id(s) for s in sub}
+                if len(sub) < 2 or ids & claimed or cand is plan.root:
+                    continue
+                ok = True
+                for s in sub:
+                    if isinstance(s, Exchange) or (
+                            isinstance(s, Scan) and not isinstance(
+                                inputs.get(s.source), Table)):
+                        ok = False
+                        break
+                    ps = parents.get(id(s), [])
+                    if (len(ps) != 1 if s is cand else
+                            any(id(p) not in ids for p in ps)):
+                        ok = False
+                        break
+                if not ok:
+                    continue
+                members = {s.label for s in sub}
+                weight = max((cert.by_label[lbl].resident_bytes_hi or 0
+                              for lbl in members
+                              if lbl in cert.by_label), default=0)
+                cands.append((cand.label, members, weight))
+                claimed |= ids
+            bounds = [b for b in cert.ops
+                      if b.resident_bytes_hi is not None]
+            offloaded: set = set()
+
+            def device_peak():
+                vals = [b.resident_bytes_hi for b in bounds
+                        if b.label not in offloaded]
+                return max(vals) if vals else 0
+
+            chosen = []
+            for root_label, members, _ in sorted(
+                    cands, key=lambda c: -c[2]):
+                if device_peak() <= quota_bytes:
+                    break
+                offloaded |= members
+                chosen.append(root_label)
+            peak = device_peak()
+            if not chosen or peak > quota_bytes:
+                return None
+            return tuple(chosen), int(peak)
+        except Exception:
+            return None
+
     def _submit(self, state: _SessionState, plan, inputs: Optional[Dict],
                 *, block: Optional[bool], timeout: Optional[float],
                 pin_cpu: bool = False) -> Ticket:
@@ -485,6 +588,7 @@ class ServingScheduler:
             source = "observed"
         ticket.charge_source = source
         tier = "device"
+        placement = None
         if pin_cpu:
             # fleet quarantine degrade (serving/fleet.py): the device
             # never sees this plan, so the device quota does not bind —
@@ -492,8 +596,10 @@ class ServingScheduler:
             tier, charge = "cpu", 0
         elif charge > state.quota_bytes:
             # can NEVER fit this session's quota: resolve now, before any
-            # compilation — reject with an attributable diagnostic, or pin
-            # to the CPU tier where the device quota does not bind
+            # compilation — reject with an attributable diagnostic, pin
+            # to the CPU tier where the device quota does not bind, or
+            # (partial) offload enough certified subtrees to co-placement
+            # host threads that the DEVICE remainder fits
             if self.over_quota == "reject":
                 with self._lock:
                     state.submitted += 1
@@ -503,7 +609,19 @@ class ServingScheduler:
                     f"plan charges {charge} B ({source}) against a "
                     f"{state.quota_bytes} B session quota",
                     session=state.id, operator=op_label)
-            tier, charge = "cpu", 0
+            split = None
+            if self.over_quota == "partial":
+                split = self._partial_placement(plan, inputs, cert,
+                                                state.quota_bytes)
+            if split is not None:
+                # quota is charged for the DEVICE footprint only — the
+                # host-placed subtrees never occupy device memory
+                # (docs/serving.md#partial-placement); the job stays on
+                # the device tier instead of the whole-plan CPU pin
+                placement, charge = split
+                ticket.charge_source = source = "partial"
+            else:
+                tier, charge = "cpu", 0
         deadline = None if timeout is None else self._clock() + timeout
         with self._lock_cond:
             if self._closed or state.closed:
@@ -536,7 +654,7 @@ class ServingScheduler:
                         "submit was blocked", session=state.id)
             job = _Job(plan, inputs, state, ticket, charge, source,
                        op_label, tier, key, self._clock(),
-                       deadline=deadline)
+                       deadline=deadline, placement=placement)
             state.queue.append(job)
             state.submitted += 1
             self._queued += 1
@@ -706,12 +824,19 @@ class ServingScheduler:
                 # the health monitor's trip log, which is what lets the
                 # fleet's poison-plan quarantine (serving/fleet.py)
                 # attribute trips to fingerprints instead of guessing
+                # placement= is only forwarded when a partial split is
+                # actually armed: executor doubles (tests, shims) that
+                # stub execute() keep working unchanged on the default
+                # path, and the kwarg's absence IS the default anyway
+                kw = ({"placement": job.placement}
+                      if job.placement is not None else {})
                 with sessionctx.session_scope(state.id), scope, \
                         self.executor.health.attribution(
                             job.plan.fingerprint):
                     result = self.executor.execute(
                         job.plan, job.inputs,
-                        tier="cpu" if job.tier == "cpu" else None)
+                        tier="cpu" if job.tier == "cpu" else None,
+                        **kw)
                 if job.cache_key is not None and not result.degraded:
                     # device-tier results only: a degraded result is a
                     # transient-condition artifact (breaker open, quota

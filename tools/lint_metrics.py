@@ -38,6 +38,14 @@ Two AST rules over ``benchmarks/`` and ``bench.py``:
   — a respawn count that does not name the replacement worker cannot
   be joined against the membership change it claims happened
   (docs/serving.md#fleet-self-healing).
+- ``missing-placement-stamp``: a call that stamps
+  ``placement_overlap_ms=`` or ``placement=`` (co-placement records,
+  plan/optimizer.py placement rule, docs/optimizer.md#placement) must
+  also stamp ``backend=`` and ``session=`` — an overlap number is a
+  host-vs-device comparison by construction, so a row that does not
+  say which device backend the overlapped walk ran on (or which tenant
+  it ran for, "" outside serving) cannot be compared across the
+  placement on/off trajectory it exists to describe.
 - ``raw-jsonl-missing-stamp``: a ``json.dumps({...literal...})`` record
   must carry ``"backend"`` and ``"kernels"`` keys — unless it carries an
   ``"error"`` key (failure records describe infrastructure, not
@@ -123,6 +131,17 @@ def _lint_file(path: str, rel: str, findings: List[str]) -> None:
                     "fleet-layer completion without the worker that "
                     "served it is not attributable across failover "
                     "(serving/fleet.py, docs/serving.md#fleet)")
+            if kw & {"placement_overlap_ms", "placement"} and \
+                    not {"backend", "session"} <= kw:
+                findings.append(
+                    f"{rel}:{node.lineno}: [missing-placement-stamp] "
+                    f"{name}() stamps placement/placement_overlap_ms "
+                    "without backend= and session= — a co-placement "
+                    "overlap number without the device backend it "
+                    "overlapped (and its tenant session, \"\" outside "
+                    "serving) is not comparable across the placement "
+                    "on/off trajectory (plan/optimizer.py, "
+                    "docs/optimizer.md#placement)")
             if "respawns" in kw and "worker_id" not in kw:
                 findings.append(
                     f"{rel}:{node.lineno}: [missing-respawn-stamp] "
